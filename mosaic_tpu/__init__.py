@@ -1,0 +1,21 @@
+"""mosaic_tpu — a TPU-native geospatial analytics framework.
+
+A from-scratch rebuild of the capabilities of databrickslabs/mosaic
+(Spark/Scala + JTS/H3/GDAL, surveyed in SURVEY.md) on JAX/XLA/Pallas:
+columns of geometries live as packed array batches in HBM, ST_/grid_/RST_
+operations are fused XLA programs, spatial joins ride cell-ID bucketing with
+the chip index all-gathered over ICI, and host C++/numpy handles codecs and
+exact geometry.
+"""
+
+from .core.types import GeometryBuilder, GeometryType, PackedGeometry, PaddedGeometry
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "GeometryBuilder",
+    "GeometryType",
+    "PackedGeometry",
+    "PaddedGeometry",
+    "__version__",
+]

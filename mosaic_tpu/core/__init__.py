@@ -1,0 +1,3 @@
+from .types import GeometryBuilder, GeometryType, PackedGeometry, PaddedGeometry
+
+__all__ = ["GeometryBuilder", "GeometryType", "PackedGeometry", "PaddedGeometry"]

@@ -1,0 +1,126 @@
+"""Device-resident geometry column (JAX pytree).
+
+This is what the reference keeps as per-row JVM geometry objects; here a whole
+column lives in HBM as one rectangular array set so every ST_ op compiles to a
+single fused XLA program. Produced from :class:`PaddedGeometry` via
+:func:`to_device`.
+
+Precision strategy (SURVEY.md §7 "hard parts"): hosts keep float64; device
+arrays default to float32 with an optional per-column ``shift`` (a float64
+origin subtracted before narrowing) so coordinates keep ~1e-7·range relative
+precision on TPU, where native f64 is emulated and slow. Tests run the same
+code in x64 on CPU meshes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..types import GeometryType, PackedGeometry, PaddedGeometry
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DeviceGeometry:
+    """Columnar geometry batch on device.
+
+    verts: (G, R, V, 2) — polygon rings closed (first vertex repeated at
+        index ``ring_len``); pad is zeros.
+    ring_len: (G, R) int32 — real vertex count per ring (no closing vertex).
+    ring_is_hole: (G, R) bool.
+    n_rings: (G,) int32.
+    geom_type: (G,) int32 — GeometryType codes.
+    shift: (2,) float64/float32 — origin that was subtracted from all
+        coordinates (host adds it back on read-off).
+    """
+
+    verts: jax.Array
+    ring_len: jax.Array
+    ring_is_hole: jax.Array
+    n_rings: jax.Array
+    geom_type: jax.Array
+    shift: jax.Array
+
+    def __len__(self):
+        return self.geom_type.shape[0]
+
+    @property
+    def vert_mask(self) -> jax.Array:
+        """(G, R, V) True for real vertices (excludes closing + pad)."""
+        idx = jnp.arange(self.verts.shape[2], dtype=jnp.int32)
+        return idx[None, None, :] < self.ring_len[:, :, None]
+
+    @property
+    def ring_mask(self) -> jax.Array:
+        idx = jnp.arange(self.verts.shape[1], dtype=jnp.int32)
+        return idx[None, :] < self.n_rings[:, None]
+
+
+def to_device(
+    padded: PaddedGeometry,
+    dtype=jnp.float32,
+    recenter: bool = False,
+) -> DeviceGeometry:
+    if not padded.rings_closed:
+        raise ValueError(
+            "DeviceGeometry kernels assume closed polygon rings; build the "
+            "PaddedGeometry with close_rings=True"
+        )
+    verts = np.asarray(padded.verts, dtype=np.float64)
+    if recenter:
+        mask = padded.vert_mask()
+        if mask.any():
+            lo = np.array(
+                [verts[..., 0][mask].min(), verts[..., 1][mask].min()]
+            )
+            hi = np.array(
+                [verts[..., 0][mask].max(), verts[..., 1][mask].max()]
+            )
+            shift = (lo + hi) / 2.0
+        else:
+            shift = np.zeros(2)
+        verts = np.where(
+            (padded.ring_len[:, :, None] > 0)[..., None], verts - shift, 0.0
+        )
+    else:
+        shift = np.zeros(2)
+    return DeviceGeometry(
+        verts=jnp.asarray(verts, dtype=dtype),
+        ring_len=jnp.asarray(padded.ring_len, dtype=jnp.int32),
+        ring_is_hole=jnp.asarray(padded.ring_is_hole),
+        n_rings=jnp.asarray(padded.n_rings, dtype=jnp.int32),
+        geom_type=jnp.asarray(padded.geom_type, dtype=jnp.int32),
+        shift=jnp.asarray(shift),
+    )
+
+
+def pack_to_device(
+    col: PackedGeometry,
+    dtype=jnp.float32,
+    max_rings: int | None = None,
+    max_verts: int | None = None,
+    recenter: bool = False,
+) -> DeviceGeometry:
+    return to_device(
+        col.to_padded(max_rings=max_rings, max_verts=max_verts, dtype=np.float64),
+        dtype=dtype,
+        recenter=recenter,
+    )
+
+
+def is_polygonal(geom_type: jax.Array) -> jax.Array:
+    return (geom_type == GeometryType.POLYGON) | (geom_type == GeometryType.MULTIPOLYGON)
+
+
+def is_linear(geom_type: jax.Array) -> jax.Array:
+    return (geom_type == GeometryType.LINESTRING) | (
+        geom_type == GeometryType.MULTILINESTRING
+    )
+
+
+def is_point_like(geom_type: jax.Array) -> jax.Array:
+    return (geom_type == GeometryType.POINT) | (geom_type == GeometryType.MULTIPOINT)

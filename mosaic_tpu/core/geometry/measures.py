@@ -1,0 +1,147 @@
+"""Jittable measure ops over :class:`DeviceGeometry` columns.
+
+Reference analog: the ST_ measure expressions (`expressions/geometry/ST_Area`,
+`ST_Length`, `ST_Centroid`, `ST_Envelope`, `ST_MinMaxXYZ`, `ST_NumPoints` …)
+whose per-row JTS calls + whole-stage codegen are replaced here by fused XLA
+programs over whole columns.
+
+All functions are pure, shape-polymorphic under jit, and operate in the
+device dtype (float32 by default; run under x64 for float64 on CPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..types import GeometryType
+from .device import DeviceGeometry, is_linear, is_point_like, is_polygonal
+
+_BIG = 1e30
+
+
+def _edge_terms(geoms: DeviceGeometry):
+    """Per-vertex edge vectors with masks.
+
+    Returns (p, q, edge_mask_poly, edge_mask_line) where p = verts[..., i, :],
+    q = verts[..., i+1, :]. Polygon rings are stored closed, so edge i is valid
+    for i < ring_len; linestrings are open, edge i valid for i < ring_len-1.
+    """
+    v = geoms.verts
+    p = v[:, :, :-1, :]
+    q = v[:, :, 1:, :]
+    idx = jnp.arange(v.shape[2] - 1, dtype=jnp.int32)[None, None, :]
+    poly_mask = idx < geoms.ring_len[:, :, None]
+    line_mask = idx < (geoms.ring_len[:, :, None] - 1)
+    return p, q, poly_mask, line_mask
+
+
+def signed_ring_areas(geoms: DeviceGeometry) -> jax.Array:
+    """(G, R) signed shoelace area per ring (CCW positive)."""
+    p, q, poly_mask, _ = _edge_terms(geoms)
+    cross = p[..., 0] * q[..., 1] - q[..., 0] * p[..., 1]
+    return 0.5 * jnp.sum(jnp.where(poly_mask, cross, 0.0), axis=-1)
+
+
+def area(geoms: DeviceGeometry) -> jax.Array:
+    """(G,) polygon area (shells CCW, holes CW ⇒ plain signed sum). 0 for
+    non-polygonal geometries (reference: JTS getArea semantics)."""
+    ring_area = signed_ring_areas(geoms)
+    total = jnp.sum(ring_area, axis=-1)
+    return jnp.where(is_polygonal(geoms.geom_type), total, 0.0)
+
+
+def _ring_lengths(geoms: DeviceGeometry) -> tuple[jax.Array, jax.Array]:
+    p, q, poly_mask, line_mask = _edge_terms(geoms)
+    seg = jnp.linalg.norm(q - p, axis=-1)
+    closed = jnp.sum(jnp.where(poly_mask, seg, 0.0), axis=-1)
+    open_ = jnp.sum(jnp.where(line_mask, seg, 0.0), axis=-1)
+    return closed, open_
+
+
+def length(geoms: DeviceGeometry) -> jax.Array:
+    """(G,) perimeter for polygons, length for lines, 0 for points.
+
+    Matches the reference where ST_Length/ST_Perimeter both call
+    `geometry.getLength` (`expressions/geometry/ST_Length.scala`)."""
+    closed, open_ = _ring_lengths(geoms)
+    poly = jnp.sum(closed, axis=-1)
+    line = jnp.sum(open_, axis=-1)
+    return jnp.where(
+        is_polygonal(geoms.geom_type),
+        poly,
+        jnp.where(is_linear(geoms.geom_type), line, 0.0),
+    )
+
+
+def centroid(geoms: DeviceGeometry) -> jax.Array:
+    """(G, 2) centroid. Polygons: area-weighted; lines: length-weighted;
+    points: vertex mean."""
+    p, q, poly_mask, line_mask = _edge_terms(geoms)
+    cross = p[..., 0] * q[..., 1] - q[..., 0] * p[..., 1]
+    cw = jnp.where(poly_mask, cross, 0.0)
+    cx = jnp.sum((p[..., 0] + q[..., 0]) * cw, axis=(-2, -1))
+    cy = jnp.sum((p[..., 1] + q[..., 1]) * cw, axis=(-2, -1))
+    a6 = 6.0 * jnp.sum(0.5 * jnp.sum(cw, axis=-1), axis=-1)
+    poly_c = jnp.stack([cx, cy], axis=-1) / jnp.where(a6 == 0, 1.0, a6)[..., None]
+
+    seg = jnp.linalg.norm(q - p, axis=-1)
+    seg_l = jnp.where(line_mask, seg, 0.0)
+    mid = 0.5 * (p + q)
+    line_c = jnp.sum(mid * seg_l[..., None], axis=(-3, -2)) / jnp.where(
+        jnp.sum(seg_l, axis=(-2, -1)) == 0, 1.0, jnp.sum(seg_l, axis=(-2, -1))
+    )[..., None]
+
+    vm = geoms.vert_mask
+    cnt = jnp.sum(vm, axis=(-2, -1))
+    pt_c = jnp.sum(
+        jnp.where(vm[..., None], geoms.verts, 0.0), axis=(-3, -2)
+    ) / jnp.where(cnt == 0, 1, cnt)[..., None]
+
+    gt = geoms.geom_type
+    out = jnp.where(
+        is_polygonal(gt)[:, None],
+        poly_c,
+        jnp.where(is_linear(gt)[:, None], line_c, pt_c),
+    )
+    return out
+
+
+def bounds(geoms: DeviceGeometry) -> jax.Array:
+    """(G, 4) [xmin, ymin, xmax, ymax]; NaN for empty geometries (matches the
+    host PackedGeometry.bounds oracle)."""
+    vm = geoms.vert_mask[..., None]
+    v = geoms.verts
+    vmin = jnp.min(jnp.where(vm, v, _BIG), axis=(-3, -2))
+    vmax = jnp.max(jnp.where(vm, v, -_BIG), axis=(-3, -2))
+    out = jnp.concatenate([vmin, vmax], axis=-1)
+    empty = ~jnp.any(vm, axis=(-3, -2, -1))
+    return jnp.where(empty[:, None], jnp.nan, out)
+
+
+def xmin(geoms: DeviceGeometry) -> jax.Array:
+    return bounds(geoms)[:, 0]
+
+
+def ymin(geoms: DeviceGeometry) -> jax.Array:
+    return bounds(geoms)[:, 1]
+
+
+def xmax(geoms: DeviceGeometry) -> jax.Array:
+    return bounds(geoms)[:, 2]
+
+
+def ymax(geoms: DeviceGeometry) -> jax.Array:
+    return bounds(geoms)[:, 3]
+
+
+def num_points(geoms: DeviceGeometry) -> jax.Array:
+    """(G,) int32 vertex count (closing vertices counted for polygon rings,
+    matching JTS getNumPoints on closed rings)."""
+    closing = (geoms.ring_len > 0) & is_polygonal(geoms.geom_type)[:, None]
+    return jnp.sum(geoms.ring_len + closing.astype(jnp.int32), axis=-1)
+
+
+def point_xy(geoms: DeviceGeometry) -> jax.Array:
+    """(G, 2) the coordinate of POINT geometries (first vertex otherwise)."""
+    return geoms.verts[:, 0, 0, :]

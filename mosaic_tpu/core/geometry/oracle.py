@@ -1,0 +1,117 @@
+"""Host (numpy, float64) oracle implementations of geometry ops.
+
+Role: the "interpreted mode" of the reference's dual eval/codegen contract
+(`MosaicSpatialQueryTest.scala:43-126` runs every expression CODEGEN_ONLY and
+NO_CODEGEN and asserts agreement). Here the matrix is: this straightforward
+per-geometry numpy oracle vs the fused jitted/Pallas device kernels — tests
+assert they agree to tolerance.
+
+Everything here is deliberately simple scalar-loop-free numpy per geometry;
+clarity over speed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..types import GeometryType, PackedGeometry, ring_signed_area
+
+
+def _rings(col: PackedGeometry, g: int):
+    for p in col.geom_parts(g):
+        for k, r in enumerate(col.part_rings(p)):
+            yield k, col.ring_xy(r)
+
+
+def _oriented(xy: np.ndarray, hole: bool) -> np.ndarray:
+    if xy.shape[0] >= 3:
+        sa = ring_signed_area(xy)
+        if (sa < 0) != hole:
+            return xy[::-1]
+    return xy
+
+
+def area(col: PackedGeometry) -> np.ndarray:
+    out = np.zeros(len(col))
+    for g in range(len(col)):
+        if col.geometry_type(g).base != GeometryType.POLYGON:
+            continue
+        tot = 0.0
+        for k, xy in _rings(col, g):
+            a = abs(ring_signed_area(xy))
+            tot += -a if k > 0 else a
+        out[g] = tot
+    return out
+
+
+def length(col: PackedGeometry) -> np.ndarray:
+    out = np.zeros(len(col))
+    for g in range(len(col)):
+        base = col.geometry_type(g).base
+        if base == GeometryType.POINT:
+            continue
+        tot = 0.0
+        for _, xy in _rings(col, g):
+            if base == GeometryType.POLYGON and xy.shape[0] >= 2:
+                xy = np.vstack([xy, xy[:1]])
+            tot += float(np.sum(np.linalg.norm(np.diff(xy, axis=0), axis=1)))
+        out[g] = tot
+    return out
+
+
+def centroid(col: PackedGeometry) -> np.ndarray:
+    out = np.zeros((len(col), 2))
+    for g in range(len(col)):
+        base = col.geometry_type(g).base
+        if base == GeometryType.POLYGON:
+            a6 = 0.0
+            c = np.zeros(2)
+            for k, xy in _rings(col, g):
+                xy = _oriented(xy, k > 0)
+                xyc = np.vstack([xy, xy[:1]])
+                p, q = xyc[:-1], xyc[1:]
+                cross = p[:, 0] * q[:, 1] - q[:, 0] * p[:, 1]
+                c += np.sum((p + q) * cross[:, None], axis=0)
+                a6 += 3.0 * np.sum(cross)
+            out[g] = c / a6 if a6 != 0 else np.mean(col.geom_xy(g), axis=0)
+        elif base == GeometryType.LINESTRING:
+            num = np.zeros(2)
+            den = 0.0
+            for _, xy in _rings(col, g):
+                seg = np.linalg.norm(np.diff(xy, axis=0), axis=1)
+                mid = 0.5 * (xy[:-1] + xy[1:])
+                num += np.sum(mid * seg[:, None], axis=0)
+                den += float(np.sum(seg))
+            out[g] = num / den if den else np.mean(col.geom_xy(g), axis=0)
+        else:
+            out[g] = np.mean(col.geom_xy(g), axis=0)
+    return out
+
+
+def point_in_polygon(col: PackedGeometry, g: int, pt: np.ndarray) -> bool:
+    """Even-odd ray crossing over all rings of polygon g (boundary excluded
+    per crossing parity; boundary points may go either way in f64)."""
+    x, y = float(pt[0]), float(pt[1])
+    inside = False
+    for _, xy in _rings(col, g):
+        n = xy.shape[0]
+        if n < 3:
+            continue
+        j = n - 1
+        for i in range(n):
+            xi, yi = xy[i]
+            xj, yj = xy[j]
+            if (yi > y) != (yj > y):
+                xcross = xi + (y - yi) * (xj - xi) / (yj - yi)
+                if x < xcross:
+                    inside = not inside
+            j = i
+    return inside
+
+
+def contains_points(col: PackedGeometry, g: int, pts: np.ndarray) -> np.ndarray:
+    return np.array([point_in_polygon(col, g, p) for p in np.atleast_2d(pts)])
+
+
+def bounds(col: PackedGeometry) -> np.ndarray:
+    return col.bounds()

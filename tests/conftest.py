@@ -1,0 +1,33 @@
+"""Test harness: virtual 8-device CPU mesh + float64 enabled.
+
+Mirrors the reference's `SparkSuite` local[4] harness
+(`src/test/scala/.../test/SparkSuite.scala:44`): distribution semantics are
+exercised without real hardware by forcing 8 XLA host-platform devices.
+
+Note: this environment's sitecustomize imports jax at interpreter startup
+(axon TPU plugin), so JAX_PLATFORMS must be overridden through jax.config,
+not os.environ. XLA_FLAGS is still read lazily at first backend init, which
+has not happened yet when conftest loads.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {devs}"
+    return devs

@@ -1,0 +1,42 @@
+"""Canonical geometry fixtures (role of the reference's `test/package.scala`
+mocks object — fresh WKT values, EPSG:4326)."""
+
+import numpy as np
+
+POINT_WKT = [
+    "POINT (10 10)",
+    "POINT (-73.985 40.748)",
+    "POINT (0 0)",
+]
+
+LINE_WKT = [
+    "LINESTRING (0 0, 1 1, 2 0, 3 1)",
+    "LINESTRING (-73.99 40.73, -73.98 40.74, -73.97 40.75)",
+]
+
+POLY_WKT = [
+    # simple square
+    "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))",
+    # square with hole
+    "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (2 2, 2 4, 4 4, 4 2, 2 2))",
+    # convex pentagon
+    "POLYGON ((0 0, 2 -1, 4 0, 3 3, 1 3, 0 0))",
+]
+
+MULTIPOLY_WKT = [
+    "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 1, 0 0)), ((5 5, 7 5, 7 7, 5 7, 5 5)))",
+]
+
+MULTIPOINT_WKT = ["MULTIPOINT ((1 1), (2 2), (3 3))"]
+MULTILINE_WKT = ["MULTILINESTRING ((0 0, 1 1), (2 2, 3 3, 4 4))"]
+
+ALL_WKT = (
+    POINT_WKT + LINE_WKT + POLY_WKT + MULTIPOLY_WKT + MULTIPOINT_WKT + MULTILINE_WKT
+)
+
+
+def random_points(n, bbox=(-74.3, 40.4, -73.6, 41.0), seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.uniform(bbox[0], bbox[2], n)
+    y = rng.uniform(bbox[1], bbox[3], n)
+    return np.column_stack([x, y])

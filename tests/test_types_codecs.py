@@ -1,0 +1,130 @@
+"""PackedGeometry + WKT/WKB/GeoJSON codec round-trips."""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry import geojson, wkb, wkt
+from mosaic_tpu.core.types import GeometryType, PackedGeometry
+
+import fixtures as fx
+
+
+def test_from_wkt_counts():
+    col = wkt.from_wkt(fx.ALL_WKT)
+    assert len(col) == len(fx.ALL_WKT)
+    assert col.geometry_type(0) == GeometryType.POINT
+    assert col.geometry_type(5) == GeometryType.POLYGON
+
+
+def test_wkt_roundtrip():
+    col = wkt.from_wkt(fx.ALL_WKT)
+    out = wkt.to_wkt(col)
+    col2 = wkt.from_wkt(out)
+    assert len(col2) == len(col)
+    np.testing.assert_allclose(col2.xy, col.xy)
+    np.testing.assert_array_equal(col2.geom_type, col.geom_type)
+    np.testing.assert_array_equal(col2.ring_offsets, col.ring_offsets)
+
+
+def test_wkb_roundtrip():
+    col = wkt.from_wkt(fx.ALL_WKT)
+    blobs = wkb.to_wkb(col)
+    col2 = wkb.from_wkb(blobs)
+    np.testing.assert_allclose(col2.xy, col.xy)
+    np.testing.assert_array_equal(col2.geom_type, col.geom_type)
+    np.testing.assert_array_equal(col2.ring_offsets, col.ring_offsets)
+    np.testing.assert_array_equal(col2.part_offsets, col.part_offsets)
+    np.testing.assert_array_equal(col2.geom_offsets, col.geom_offsets)
+
+
+def test_hex_roundtrip():
+    col = wkt.from_wkt(fx.POLY_WKT)
+    hexes = wkb.to_hex(col)
+    col2 = wkb.from_hex(hexes)
+    np.testing.assert_allclose(col2.xy, col.xy)
+
+
+def test_geojson_roundtrip():
+    col = wkt.from_wkt(fx.ALL_WKT)
+    docs = geojson.to_geojson(col)
+    col2 = geojson.from_geojson(docs)
+    np.testing.assert_allclose(col2.xy, col.xy)
+    np.testing.assert_array_equal(col2.geom_type, col.geom_type)
+
+
+def test_wkb_z_roundtrip():
+    col = wkt.from_wkt(["POINT Z (1 2 3)", "LINESTRING Z (0 0 1, 1 1 2)"])
+    assert col.z is not None
+    np.testing.assert_allclose(col.z, [3, 1, 2])
+    col2 = wkb.from_wkb(wkb.to_wkb(col))
+    np.testing.assert_allclose(col2.z, [3, 1, 2])
+
+
+def test_srid_parse():
+    col = wkt.from_wkt(["SRID=27700;POINT (400000 100000)"])
+    assert col.srid[0] == 27700
+
+
+def test_from_points_vectorized():
+    pts = np.random.default_rng(0).uniform(-10, 10, (100, 2))
+    col = PackedGeometry.from_points(pts)
+    assert len(col) == 100
+    np.testing.assert_allclose(col.geom_xy(7), pts[7:8])
+
+
+def test_take_and_concat():
+    col = wkt.from_wkt(fx.ALL_WKT)
+    sub = col.take([5, 0, 8])
+    assert len(sub) == 3
+    assert sub.geometry_type(0) == GeometryType.POLYGON
+    assert wkt.to_wkt(sub)[1] == wkt.to_wkt(col)[0]
+    both = sub.concat(col)
+    assert len(both) == 3 + len(col)
+
+
+def test_padded_form():
+    col = wkt.from_wkt(fx.POLY_WKT)
+    padded = col.to_padded()
+    assert padded.verts.shape[0] == 3
+    assert padded.ring_len[1, 1] == 4  # hole ring, open form
+    assert padded.ring_is_hole[1, 1]
+    # closing vertex present
+    v = padded.verts[0, 0]
+    n = padded.ring_len[0, 0]
+    np.testing.assert_allclose(v[n], v[0])
+
+
+def test_bounds():
+    col = wkt.from_wkt(fx.POLY_WKT)
+    b = col.bounds()
+    np.testing.assert_allclose(b[0], [0, 0, 4, 4])
+    np.testing.assert_allclose(b[1], [0, 0, 10, 10])
+
+
+def test_feature_collection(tmp_path):
+    fc = {
+        "type": "FeatureCollection",
+        "features": [
+            {
+                "type": "Feature",
+                "properties": {"name": "a"},
+                "geometry": {"type": "Point", "coordinates": [1.0, 2.0]},
+            },
+            {
+                "type": "Feature",
+                "properties": {"name": "b"},
+                "geometry": {
+                    "type": "Polygon",
+                    "coordinates": [[[0, 0], [1, 0], [1, 1], [0, 0]]],
+                },
+            },
+        ],
+    }
+    import json
+
+    p = tmp_path / "fc.geojson"
+    p.write_text(json.dumps(fc))
+    col, props = geojson.read_feature_collection(str(p))
+    assert len(col) == 2
+    assert props[0]["name"] == "a"
+    assert col.geometry_type(1) == GeometryType.POLYGON
