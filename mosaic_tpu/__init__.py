@@ -8,6 +8,19 @@ the chip index all-gathered over ICI, and host C++/numpy handles codecs and
 exact geometry.
 """
 
+import jax as _jax
+
+# Grid cell ids are int64 (H3 ids use all 64 bits; BNG decimal ids reach 1e17)
+# and host-side coordinates are float64. Without x64, jnp.int64 silently
+# downcasts to int32 and every cell id wraps to garbage — so the framework
+# requires x64 mode. Device-side hot kernels still request float32 explicitly,
+# so TPU compute stays in fast dtypes. Set MOSAIC_TPU_NO_X64=1 to opt out
+# (only safe if you never touch cell ids).
+import os as _os
+
+if not _os.environ.get("MOSAIC_TPU_NO_X64"):
+    _jax.config.update("jax_enable_x64", True)
+
 from .core.types import GeometryBuilder, GeometryType, PackedGeometry, PaddedGeometry
 
 __version__ = "0.1.0"

@@ -112,6 +112,32 @@ def pack_to_device(
     )
 
 
+def edges(geoms: DeviceGeometry):
+    """Shared edge extraction: returns (a, b, poly_mask, line_mask, type_mask).
+
+    a, b: (G, R, V-1, 2) edge endpoints. ``poly_mask`` treats rings as closed
+    (valid for i < ring_len, polygon rings store the closing vertex);
+    ``line_mask`` treats them as open (i < ring_len - 1). ``type_mask`` picks
+    the right one per geometry's type (points contribute no edges).
+
+    Single source of truth for measures, predicates and the Pallas kernel
+    edge-plane packing — keep them in sync by construction.
+    """
+    v = geoms.verts
+    a = v[:, :, :-1, :]
+    b = v[:, :, 1:, :]
+    idx = jnp.arange(v.shape[2] - 1, dtype=jnp.int32)[None, None, :]
+    poly_mask = idx < geoms.ring_len[:, :, None]
+    line_mask = idx < (geoms.ring_len[:, :, None] - 1)
+    gt = geoms.geom_type
+    type_mask = jnp.where(
+        is_polygonal(gt)[:, None, None],
+        poly_mask,
+        jnp.where(is_linear(gt)[:, None, None], line_mask, False),
+    )
+    return a, b, poly_mask, line_mask, type_mask
+
+
 def is_polygonal(geom_type: jax.Array) -> jax.Array:
     return (geom_type == GeometryType.POLYGON) | (geom_type == GeometryType.MULTIPOLYGON)
 

@@ -111,7 +111,10 @@ def to_geojson_obj(col: PackedGeometry) -> list[dict[str, Any]]:
                 for r in col.part_rings(p)
             ]
 
-        if gt == GeometryType.POINT:
+        if gt == GeometryType.GEOMETRYCOLLECTION:
+            # only empties are representable (null-geometry features)
+            obj = {"type": "GeometryCollection", "geometries": []}
+        elif gt == GeometryType.POINT:
             rings = [r for p in parts for r in col.part_rings(p)]
             c = (
                 _coords_json(col.ring_xy(rings[0]), ring_z(rings[0]), False)[0]

@@ -15,24 +15,14 @@ import jax
 import jax.numpy as jnp
 
 from ..types import GeometryType
-from .device import DeviceGeometry, is_linear, is_point_like, is_polygonal
+from .device import DeviceGeometry, edges, is_linear, is_point_like, is_polygonal
 
 _BIG = 1e30
 
 
 def _edge_terms(geoms: DeviceGeometry):
-    """Per-vertex edge vectors with masks.
-
-    Returns (p, q, edge_mask_poly, edge_mask_line) where p = verts[..., i, :],
-    q = verts[..., i+1, :]. Polygon rings are stored closed, so edge i is valid
-    for i < ring_len; linestrings are open, edge i valid for i < ring_len-1.
-    """
-    v = geoms.verts
-    p = v[:, :, :-1, :]
-    q = v[:, :, 1:, :]
-    idx = jnp.arange(v.shape[2] - 1, dtype=jnp.int32)[None, None, :]
-    poly_mask = idx < geoms.ring_len[:, :, None]
-    line_mask = idx < (geoms.ring_len[:, :, None] - 1)
+    """Edge endpoints + closed/open masks (see device.edges)."""
+    p, q, poly_mask, line_mask, _ = edges(geoms)
     return p, q, poly_mask, line_mask
 
 
@@ -98,6 +88,9 @@ def centroid(geoms: DeviceGeometry) -> jax.Array:
         jnp.where(vm[..., None], geoms.verts, 0.0), axis=(-3, -2)
     ) / jnp.where(cnt == 0, 1, cnt)[..., None]
 
+    # degenerate (zero-area) polygons fall back to the vertex mean, matching
+    # the host oracle
+    poly_c = jnp.where((a6 == 0)[:, None], pt_c, poly_c)
     gt = geoms.geom_type
     out = jnp.where(
         is_polygonal(gt)[:, None],

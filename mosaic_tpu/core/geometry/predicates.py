@@ -1,0 +1,223 @@
+"""Jittable spatial predicates over device geometry columns.
+
+Reference analog: `ST_Contains`/`ST_Intersects`/`ST_Within`/`ST_Distance`
+(`expressions/geometry/ST_Contains.scala` → JTS `geometry.contains` at
+`core/geometry/MosaicGeometryJTS.scala:101`). The reference evaluates these
+per row on the JVM; here whole point batches are tested against whole polygon
+batches in one fused XLA program (the billion-row PIP-join hot path,
+SURVEY.md §3.4). A Pallas TPU kernel for the densest case lives in
+`mosaic_tpu.kernels.pip`; this module is the reference jnp implementation and
+the building blocks (edge accumulation, bbox prefilters, segment distances).
+
+Robustness: even-odd ray crossing with half-open interval logic — points
+exactly on a boundary may classify either way in f32 (SURVEY.md §7 precision
+strategy: conservative epsilon band + host recheck for borderline cases).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .device import DeviceGeometry, edges
+
+_BIG = 1e30
+
+
+def _poly_edges(polys: DeviceGeometry):
+    """Edges (a, b) with the closed-ring mask — for ray-crossing PIP where
+    only polygon rings matter. Shapes (G, R, V-1, 2)."""
+    a, b, poly_mask, _, _ = edges(polys)
+    return a, b, poly_mask
+
+
+def _boundary_edges(geoms: DeviceGeometry):
+    """Edges with the type-aware mask (closed for polygons, open for lines,
+    none for points) — for distance / edge-crossing predicates."""
+    a, b, _, _, type_mask = edges(geoms)
+    return a, b, type_mask
+
+
+def crossing_number(points: jax.Array, polys: DeviceGeometry) -> jax.Array:
+    """(N, G) int32 — ray-crossing counts of each point vs each polygon
+    (all rings; holes flip parity naturally). Dense N×G — the broadcast-join
+    pattern where the polygon table is small (e.g. 263 NYC taxi zones)."""
+    a, b, mask = _poly_edges(polys)  # (G,R,E,2)
+    px = points[:, 0][:, None, None, None]  # (N,1,1,1)
+    py = points[:, 1][:, None, None, None]
+    ay, by = a[None, ..., 1], b[None, ..., 1]
+    ax, bx = a[None, ..., 0], b[None, ..., 0]
+    straddle = (ay > py) != (by > py)
+    denom = by - ay
+    denom = jnp.where(denom == 0, 1.0, denom)
+    xcross = ax + (py - ay) * (bx - ax) / denom
+    hit = straddle & (px < xcross) & mask[None]
+    return jnp.sum(hit, axis=(-2, -1)).astype(jnp.int32)  # (N, G)
+
+
+def contains_xy(points: jax.Array, polys: DeviceGeometry) -> jax.Array:
+    """(N, G) bool — point-in-polygon, even-odd rule."""
+    return (crossing_number(points, polys) & 1) == 1
+
+
+def contains_xy_bbox(points: jax.Array, polys: DeviceGeometry) -> jax.Array:
+    """contains_xy with a fused bbox prefilter (cheap reject before edges)."""
+    from .measures import bounds
+
+    bb = bounds(polys)  # (G,4)
+    px, py = points[:, 0][:, None], points[:, 1][:, None]
+    in_bb = (px >= bb[None, :, 0]) & (py >= bb[None, :, 1]) & (
+        px <= bb[None, :, 2]
+    ) & (py <= bb[None, :, 3])
+    return in_bb & contains_xy(points, polys)
+
+
+def contains_xy_gather(
+    points: jax.Array, poly_idx: jax.Array, polys: DeviceGeometry
+) -> jax.Array:
+    """(N,) bool — each point tested against its own polygon ``poly_idx[i]``.
+
+    This is the post-cell-join shape: after bucketing by grid cell, each
+    candidate (point, border-chip) pair tests one clipped chip polygon.
+    """
+    a, b, mask = _poly_edges(polys)  # (G,R,E,2)
+    ga = a[poly_idx]  # (N,R,E,2)
+    gb = b[poly_idx]
+    gm = mask[poly_idx]
+    px = points[:, 0][:, None, None]
+    py = points[:, 1][:, None, None]
+    ay, by = ga[..., 1], gb[..., 1]
+    ax, bx = ga[..., 0], gb[..., 0]
+    straddle = (ay > py) != (by > py)
+    denom = jnp.where(by - ay == 0, 1.0, by - ay)
+    xcross = ax + (py - ay) * (bx - ax) / denom
+    hit = straddle & (px < xcross) & gm
+    return (jnp.sum(hit, axis=(-2, -1)).astype(jnp.int32) & 1) == 1
+
+
+# --------------------------------------------------------------- segments
+def _seg_seg_intersect(p1, p2, q1, q2):
+    """Proper + touching segment intersection via orientation tests.
+
+    All args (..., 2); returns (...,) bool."""
+
+    def cross(o, a, b):
+        return (a[..., 0] - o[..., 0]) * (b[..., 1] - o[..., 1]) - (
+            a[..., 1] - o[..., 1]
+        ) * (b[..., 0] - o[..., 0])
+
+    d1 = cross(q1, q2, p1)
+    d2 = cross(q1, q2, p2)
+    d3 = cross(p1, p2, q1)
+    d4 = cross(p1, p2, q2)
+    proper = ((d1 > 0) != (d2 > 0)) & ((d3 > 0) != (d4 > 0))
+
+    def on_seg(a, b, c, d):
+        # collinear c on segment ab
+        return (
+            (d == 0)
+            & (jnp.minimum(a[..., 0], b[..., 0]) <= c[..., 0])
+            & (c[..., 0] <= jnp.maximum(a[..., 0], b[..., 0]))
+            & (jnp.minimum(a[..., 1], b[..., 1]) <= c[..., 1])
+            & (c[..., 1] <= jnp.maximum(a[..., 1], b[..., 1]))
+        )
+
+    touch = (
+        on_seg(q1, q2, p1, d1)
+        | on_seg(q1, q2, p2, d2)
+        | on_seg(p1, p2, q1, d3)
+        | on_seg(p1, p2, q2, d4)
+    )
+    return proper | touch
+
+
+def _point_seg_dist2(p, a, b):
+    """Squared distance from points p (...,2) to segments (a, b) (...,2)."""
+    ab = b - a
+    ap = p - a
+    denom = jnp.sum(ab * ab, axis=-1)
+    t = jnp.sum(ap * ab, axis=-1) / jnp.where(denom == 0, 1.0, denom)
+    t = jnp.clip(t, 0.0, 1.0)
+    proj = a + t[..., None] * ab
+    d = p - proj
+    return jnp.sum(d * d, axis=-1)
+
+
+def edges_intersect(ga: DeviceGeometry, gb: DeviceGeometry) -> jax.Array:
+    """(Ga, Gb) bool — any boundary edge of a crosses any edge of b."""
+    a1, a2, am = _boundary_edges(ga)
+    b1, b2, bm = _boundary_edges(gb)
+    # flatten ring/edge dims
+    A = a1.shape[0]
+    B = b1.shape[0]
+    a1f = a1.reshape(A, -1, 2)
+    a2f = a2.reshape(A, -1, 2)
+    amf = am.reshape(A, -1)
+    b1f = b1.reshape(B, -1, 2)
+    b2f = b2.reshape(B, -1, 2)
+    bmf = bm.reshape(B, -1)
+    hit = _seg_seg_intersect(
+        a1f[:, None, :, None, :],
+        a2f[:, None, :, None, :],
+        b1f[None, :, None, :, :],
+        b2f[None, :, None, :, :],
+    )
+    m = amf[:, None, :, None] & bmf[None, :, None, :]
+    return jnp.any(hit & m, axis=(-2, -1))
+
+
+def min_distance(ga: DeviceGeometry, gb: DeviceGeometry) -> jax.Array:
+    """(Ga, Gb) min boundary distance (0 if boundaries cross). Interior
+    containment is NOT folded in here — `distance` below handles that."""
+    a1, a2, am = _boundary_edges(ga)
+    b1, b2, bm = _boundary_edges(gb)
+    A, B = a1.shape[0], b1.shape[0]
+    a1f, a2f = a1.reshape(A, -1, 2), a2.reshape(A, -1, 2)
+    amf = am.reshape(A, -1)
+    b1f, b2f = b1.reshape(B, -1, 2), b2.reshape(B, -1, 2)
+    bmf = bm.reshape(B, -1)
+
+    # vertex-of-a to segment-of-b
+    d_ab = _point_seg_dist2(
+        a1f[:, None, :, None, :], b1f[None, :, None, :, :], b2f[None, :, None, :, :]
+    )
+    m_ab = amf[:, None, :, None] & bmf[None, :, None, :]
+    d_ab = jnp.where(m_ab, d_ab, _BIG)
+    # vertex-of-b to segment-of-a
+    d_ba = _point_seg_dist2(
+        b1f[None, :, None, :, :], a1f[:, None, :, None, :], a2f[:, None, :, None, :]
+    )
+    d_ba = jnp.where(m_ab, d_ba, _BIG)
+    d2 = jnp.minimum(jnp.min(d_ab, axis=(-2, -1)), jnp.min(d_ba, axis=(-2, -1)))
+    crossed = edges_intersect(ga, gb)
+    return jnp.where(crossed, 0.0, jnp.sqrt(d2))
+
+
+def points_min_dist(points: jax.Array, polys: DeviceGeometry) -> jax.Array:
+    """(N, G) distance from each point to each geometry boundary (0 inside
+    polygons)."""
+    a, b, mask = _boundary_edges(polys)
+    G = a.shape[0]
+    af = a.reshape(G, -1, 2)
+    bf = b.reshape(G, -1, 2)
+    mf = mask.reshape(G, -1)
+    d2 = _point_seg_dist2(
+        points[:, None, None, :], af[None, :, :, :], bf[None, :, :, :]
+    )
+    d2 = jnp.where(mf[None], d2, _BIG)
+    d = jnp.sqrt(jnp.min(d2, axis=-1))
+    inside = contains_xy(points, polys)
+    return jnp.where(inside, 0.0, d)
+
+
+def intersects(ga: DeviceGeometry, gb: DeviceGeometry) -> jax.Array:
+    """(Ga, Gb) bool polygon/polygon intersects: edges cross, or a vertex of
+    one lies inside the other (covers containment)."""
+    cross = edges_intersect(ga, gb)
+    # representative vertex containment both ways
+    va = ga.verts[:, 0, 0, :]  # (Ga,2) first vertex
+    vb = gb.verts[:, 0, 0, :]
+    a_in_b = contains_xy(va, gb)  # (Ga,Gb)
+    b_in_a = contains_xy(vb, ga).T  # (Ga,Gb)
+    nonempty = (ga.ring_len[:, 0] > 0)[:, None] & (gb.ring_len[:, 0] > 0)[None, :]
+    return (cross | a_in_b | b_in_a) & nonempty

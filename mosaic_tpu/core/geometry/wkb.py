@@ -127,8 +127,13 @@ def _append_wkb(builder: GeometryBuilder, r: _Reader, default_srid: int) -> None
                 builder.end_part()
             else:
                 raise ValueError(f"invalid WKB: {sgt} inside {gtype}")
+    elif gtype == GeometryType.GEOMETRYCOLLECTION:
+        n = r.u32(bo)
+        if n:
+            raise NotImplementedError("non-empty GEOMETRYCOLLECTION WKB")
+        builder.end_part()
     else:
-        raise NotImplementedError("GEOMETRYCOLLECTION WKB")
+        raise NotImplementedError(f"WKB geometry type {gtype}")
     builder.end_geom(gtype, srid)
 
 
@@ -171,6 +176,11 @@ def to_wkb(col: PackedGeometry) -> list[bytes]:
         buf += b"\x01"
         buf += struct.pack("<I", _geom_code(gt, has_z))
         parts = list(col.geom_parts(g))
+        if gt == GeometryType.GEOMETRYCOLLECTION:
+            # only empties are representable (null-geometry features)
+            buf += struct.pack("<I", 0)
+            out.append(bytes(buf))
+            continue
 
         def ring_data(r):
             z = col.ring_z(r)

@@ -1,0 +1,30 @@
+from .base import IndexSystem
+from .bng import BNGIndexSystem
+from .custom import CustomIndexSystem, GridConf, custom_from_name
+
+BNG = BNGIndexSystem()
+
+
+def index_system_from_name(name: str) -> IndexSystem:
+    """Factory (reference: `core/index/IndexSystemFactory.scala:3-26`)."""
+    up = name.strip().upper()
+    if up == "BNG":
+        return BNG
+    if up == "H3":
+        from .h3 import H3IndexSystem
+
+        return H3IndexSystem()
+    if up.startswith("CUSTOM"):
+        return custom_from_name(name)
+    raise ValueError(f"unknown index system {name!r}")
+
+
+__all__ = [
+    "BNG",
+    "BNGIndexSystem",
+    "CustomIndexSystem",
+    "GridConf",
+    "IndexSystem",
+    "custom_from_name",
+    "index_system_from_name",
+]

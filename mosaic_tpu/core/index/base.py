@@ -1,0 +1,118 @@
+"""IndexSystem contract: pluggable grid indexes, batch-first.
+
+Reference analog: `core/index/IndexSystem.scala:13-221` — a per-cell OO
+contract (pointToIndex, polyfill, kRing, indexToGeometry ...). The TPU-native
+contract is *columnar*: every operation takes and returns arrays so it can be
+vmapped/jitted and sharded over device meshes. Cell IDs are always int64 on
+device; string formatting happens only at the host edge (the reference's
+Long/String cell-id duality, `functions/MosaicContext.scala:41-48`, becomes a
+pair of host codec methods).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Sequence
+
+import jax
+import numpy as np
+
+
+class IndexSystem(abc.ABC):
+    """Grid index systems map points/geometries <-> integer cell ids.
+
+    All array methods accept numpy or jax arrays and are jit-compatible
+    (static resolution argument) unless documented host-only.
+    """
+
+    name: str = "?"
+    #: number of vertices of a cell boundary polygon (4 for squares, up to 10
+    #: for H3 cells with distortion vertices; boundaries are padded to this).
+    boundary_max_verts: int = 4
+
+    # ------------------------------------------------------------- metadata
+    @abc.abstractmethod
+    def resolutions(self) -> Sequence[int]: ...
+
+    def min_resolution(self) -> int:
+        return min(self.resolutions())
+
+    def max_resolution(self) -> int:
+        return max(self.resolutions())
+
+    @abc.abstractmethod
+    def resolution_of(self, cells: jax.Array) -> jax.Array:
+        """(N,) int32 resolution of each cell id."""
+
+    # ------------------------------------------------------------ core math
+    @abc.abstractmethod
+    def point_to_cell(self, xy: jax.Array, resolution: int) -> jax.Array:
+        """(N, 2) coords -> (N,) int64 cell ids. Jittable, vmapped inside."""
+
+    @abc.abstractmethod
+    def cell_center(self, cells: jax.Array) -> jax.Array:
+        """(N,) int64 -> (N, 2) cell center coordinates."""
+
+    @abc.abstractmethod
+    def cell_boundary(self, cells: jax.Array) -> jax.Array:
+        """(N,) int64 -> (N, boundary_max_verts, 2) boundary polygons (CCW,
+        padded by repeating the last vertex)."""
+
+    @abc.abstractmethod
+    def k_ring(self, cells: jax.Array, k: int) -> jax.Array:
+        """(N,) -> (N, M) filled disk of radius k (cell itself included).
+        M is static for the system/k; invalid slots are -1."""
+
+    @abc.abstractmethod
+    def k_loop(self, cells: jax.Array, k: int) -> jax.Array:
+        """(N,) -> (N, M) hollow ring at exactly distance k; -1 pads."""
+
+    @abc.abstractmethod
+    def grid_distance(self, cells_a: jax.Array, cells_b: jax.Array) -> jax.Array:
+        """(N,),(N,) -> (N,) int64 grid distance, consistent with k_loop:
+        grid_distance(c, n) == k for every n in k_loop(c, k)."""
+
+    @abc.abstractmethod
+    def buffer_radius(self, resolution: int) -> float:
+        """Radius (in CRS units) that guarantees a cell containing any point
+        of a geometry is reached by buffering the geometry by this much
+        (reference: IndexSystem.getBufferRadius)."""
+
+    # ------------------------------------------------------------ polyfill
+    @abc.abstractmethod
+    def polyfill_candidates(
+        self, bounds: np.ndarray, resolution: int
+    ) -> np.ndarray:
+        """Host: candidate cell ids (K,) covering a bbox [xmin,ymin,xmax,ymax].
+
+        Polyfill = candidates whose *center* falls inside the geometry
+        (centroid rule, matching the reference's H3 polyfill semantics and its
+        BNG centroid-BFS). The center test runs on device via the PIP kernel.
+        """
+
+    # ------------------------------------------------------------- strings
+    @abc.abstractmethod
+    def format(self, cells: np.ndarray) -> list[str]:
+        """Host: int64 ids -> canonical string ids."""
+
+    @abc.abstractmethod
+    def parse(self, strs: Sequence[str]) -> np.ndarray:
+        """Host: string ids -> int64 ids."""
+
+    # ------------------------------------------------------------ validity
+    @abc.abstractmethod
+    def is_valid(self, cells: jax.Array) -> jax.Array:
+        """(N,) -> (N,) bool."""
+
+    # -------------------------------------------------------- conveniences
+    def cell_area_approx(self, resolution: int) -> float:
+        """Mean cell area in CRS units (used by the resolution analyzer)."""
+        raise NotImplementedError
+
+    def resolution_arg(self, res) -> int:
+        """Parse user resolution input (int or string like '500m')."""
+        if isinstance(res, (int, np.integer)):
+            if int(res) not in set(self.resolutions()):
+                raise ValueError(f"{self.name}: unsupported resolution {res}")
+            return int(res)
+        raise ValueError(f"{self.name}: unsupported resolution {res!r}")

@@ -1,0 +1,3 @@
+from . import pip
+
+__all__ = ["pip"]

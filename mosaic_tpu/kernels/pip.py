@@ -1,0 +1,176 @@
+"""Pallas TPU kernel: batched ray-crossing point-in-polygon.
+
+This is the north-star kernel (BASELINE.json): the reference evaluates
+`ST_Contains` per row through JTS (`core/geometry/MosaicGeometryJTS.scala:101`)
+inside Spark codegen; here a block of points is tested against a whole
+polygon table resident in VMEM, with the edge dimension streamed through the
+grid so arbitrarily large polygon tables tile cleanly.
+
+Layout: polygon edges are transposed to ``[E_pad, G_pad]`` coordinate planes
+(lane dimension = polygons, sublane = edges) so one edge across all polygons
+is a contiguous ``[1, G]`` vector row; points tile as ``[TN]`` blocks.
+The kernel accumulates per-(point, polygon) crossing parity and reduces to
+the smallest containing polygon id per point, so HBM output is O(N), not
+O(N·G).
+
+The jnp reference implementation (`core.geometry.predicates.contains_xy`)
+is the interpreted oracle; tests assert agreement (SURVEY.md §4(b)).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..core.geometry.device import DeviceGeometry
+
+_BIG_F = 1e30
+
+
+def _pad_to(x: np.ndarray | jax.Array, size: int, axis: int, value=0):
+    pad = size - x.shape[axis]
+    if pad <= 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths, constant_values=value)
+
+
+def edge_planes(polys: DeviceGeometry, g_pad: int = 128, e_pad: int = 64):
+    """Flatten a polygon column to edge coordinate planes ``[4, E, G]``.
+
+    Returns (planes, g_real) where planes[0..3] = ax, ay, bx, by and invalid
+    edges are encoded as degenerate (ay == by == BIG) so they never straddle
+    any point's scanline. ``e_pad`` should be a multiple of pip_zone's
+    ``tile_e`` (defaults are aligned).
+    """
+    from ..core.geometry.device import edges as _edges
+
+    v = polys.verts  # (G,R,V,2)
+    G, R, V = v.shape[0], v.shape[1], v.shape[2]
+    a4, b4, poly_mask, _, _ = _edges(polys)
+    a = a4.reshape(G, R * (V - 1), 2)
+    b = b4.reshape(G, R * (V - 1), 2)
+    mask = poly_mask.reshape(G, R * (V - 1))
+    ax = jnp.where(mask, a[..., 0], 0.0).T  # (E,G)
+    ay = jnp.where(mask, a[..., 1], _BIG_F).T
+    bx = jnp.where(mask, b[..., 0], 0.0).T
+    by = jnp.where(mask, b[..., 1], _BIG_F).T
+    E = ax.shape[0]
+    g_sz = ((G + g_pad - 1) // g_pad) * g_pad
+    e_sz = ((E + e_pad - 1) // e_pad) * e_pad
+    planes = jnp.stack(
+        [
+            _pad_to(_pad_to(ax, e_sz, 0, 0.0), g_sz, 1, 0.0),
+            _pad_to(_pad_to(ay, e_sz, 0, _BIG_F), g_sz, 1, _BIG_F),
+            _pad_to(_pad_to(bx, e_sz, 0, 0.0), g_sz, 1, 0.0),
+            _pad_to(_pad_to(by, e_sz, 0, _BIG_F), g_sz, 1, _BIG_F),
+        ]
+    ).astype(polys.verts.dtype)
+    return planes, G
+
+
+def _pip_zone_kernel(px_ref, py_ref, planes_ref, out_ref, cnt, *, tile_e, n_real_g):
+    """Grid = (n_point_blocks, n_edge_blocks); edge dim innermost."""
+    e_blk = pl.program_id(1)
+    n_e = pl.num_programs(1)
+
+    @pl.when(e_blk == 0)
+    def _():
+        cnt[:] = jnp.zeros_like(cnt)
+
+    px = px_ref[0, :][:, None]  # (TN,1)
+    py = py_ref[0, :][:, None]
+
+    def body(i, acc):
+        ay = planes_ref[1, i, :][None, :]  # (1,G)
+        by = planes_ref[3, i, :][None, :]
+        ax = planes_ref[0, i, :][None, :]
+        bx = planes_ref[2, i, :][None, :]
+        straddle = (ay > py) != (by > py)
+        denom = by - ay
+        denom = jnp.where(denom == 0, 1.0, denom)
+        xcross = ax + (py - ay) * (bx - ax) / denom
+        hit = straddle & (px < xcross)
+        return acc + hit.astype(jnp.int32)
+
+    cnt[:] = jax.lax.fori_loop(0, tile_e, body, cnt[:])
+
+    @pl.when(e_blk == n_e - 1)
+    def _():
+        inside = (cnt[:] & 1) == 1
+        g_ids = jax.lax.broadcasted_iota(jnp.int32, cnt.shape, dimension=1)
+        valid = inside & (g_ids < n_real_g)
+        first = jnp.min(jnp.where(valid, g_ids, jnp.int32(2**30)), axis=1)
+        out_ref[0, :] = jnp.where(first == 2**30, -1, first)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_real_g", "tile_n", "tile_e", "interpret")
+)
+def pip_zone(
+    points: jax.Array,
+    planes: jax.Array,
+    n_real_g: int | jax.Array = None,
+    tile_n: int = 1024,
+    tile_e: int = 64,
+    interpret: bool = False,
+) -> jax.Array:
+    """For each point, the id of the first polygon containing it, else -1.
+
+    points: (N, 2); planes: (4, E, G) from :func:`edge_planes`.
+    N is padded to tile_n internally; E and G must already be padded
+    (edge_planes does this).
+    """
+    if n_real_g is None:
+        n_real_g = planes.shape[2]
+    N = points.shape[0]
+    n_pad = ((N + tile_n - 1) // tile_n) * tile_n
+    px = _pad_to(points[:, 0], n_pad, 0, _BIG_F).reshape(-1, tile_n)
+    py = _pad_to(points[:, 1], n_pad, 0, _BIG_F).reshape(-1, tile_n)
+    E, G = planes.shape[1], planes.shape[2]
+    if E % tile_e:
+        e_sz = ((E + tile_e - 1) // tile_e) * tile_e
+        pad_vals = jnp.array([0.0, _BIG_F, 0.0, _BIG_F], planes.dtype)[:, None, None]
+        planes = jnp.concatenate(
+            [planes, jnp.broadcast_to(pad_vals, (4, e_sz - E, G))], axis=1
+        )
+        E = e_sz
+    n_blocks, n_e = px.shape[0], E // tile_e
+
+    kernel = functools.partial(
+        _pip_zone_kernel, tile_e=tile_e, n_real_g=int(n_real_g)
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_blocks, n_e),
+        in_specs=[
+            pl.BlockSpec((1, tile_n), lambda i, e: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_n), lambda i, e: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(
+                (4, tile_e, G), lambda i, e: (0, e, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile_n), lambda i, e: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_blocks, tile_n), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((tile_n, G), jnp.int32)],
+        interpret=interpret,
+    )(px, py, planes)
+    return out.reshape(-1)[:N]
+
+
+def pip_zone_reference(points: jax.Array, polys: DeviceGeometry) -> jax.Array:
+    """jnp oracle for pip_zone (first containing polygon id per point)."""
+    from ..core.geometry.predicates import contains_xy
+
+    inside = contains_xy(points, polys)  # (N,G)
+    g_ids = jnp.arange(inside.shape[1], dtype=jnp.int32)[None, :]
+    first = jnp.min(jnp.where(inside, g_ids, jnp.int32(2**30)), axis=1)
+    return jnp.where(first == 2**30, -1, first)

@@ -1,0 +1,109 @@
+"""Predicates: jnp vs host oracle, and Pallas kernel vs jnp reference."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry import oracle, predicates, wkt
+from mosaic_tpu.core.geometry.device import pack_to_device
+from mosaic_tpu.kernels import pip
+
+import fixtures as fx
+
+
+@pytest.fixture(scope="module")
+def polys():
+    return wkt.from_wkt(fx.POLY_WKT + fx.MULTIPOLY_WKT)
+
+
+@pytest.fixture(scope="module")
+def dev(polys):
+    return pack_to_device(polys, dtype=jnp.float64)
+
+
+def test_contains_matches_oracle(polys, dev):
+    pts = fx.random_points(500, bbox=(-1, -2, 11, 11), seed=1)
+    got = np.asarray(predicates.contains_xy(jnp.asarray(pts), dev))
+    for g in range(len(polys)):
+        want = oracle.contains_points(polys, g, pts)
+        np.testing.assert_array_equal(got[:, g], want)
+
+
+def test_contains_hole(dev):
+    pts = jnp.array([[3.0, 3.0], [5.0, 5.0], [1.0, 1.0]])
+    got = np.asarray(predicates.contains_xy(pts, dev))
+    # geometry 1 is the square with a hole at [2,4]x[2,4]
+    assert not got[0, 1]  # inside hole
+    assert got[1, 1]
+    assert got[2, 1]
+
+
+def test_contains_multipolygon(dev):
+    pts = jnp.array([[0.5, 0.5], [6.0, 6.0], [3.0, 3.0]])
+    got = np.asarray(predicates.contains_xy(pts, dev))
+    assert got[0, 3] and got[1, 3] and not got[2, 3]
+
+
+def test_contains_gather(polys, dev):
+    pts = fx.random_points(200, bbox=(-1, -2, 11, 11), seed=2)
+    idx = np.random.default_rng(0).integers(0, len(polys), 200)
+    got = np.asarray(
+        predicates.contains_xy_gather(jnp.asarray(pts), jnp.asarray(idx), dev)
+    )
+    dense = np.asarray(predicates.contains_xy(jnp.asarray(pts), dev))
+    np.testing.assert_array_equal(got, dense[np.arange(200), idx])
+
+
+def test_bbox_prefilter_consistent(polys, dev):
+    pts = fx.random_points(300, bbox=(-1, -2, 11, 11), seed=3)
+    plain = np.asarray(predicates.contains_xy(jnp.asarray(pts), dev))
+    pre = np.asarray(predicates.contains_xy_bbox(jnp.asarray(pts), dev))
+    np.testing.assert_array_equal(plain, pre)
+
+
+def test_intersects(dev):
+    got = np.asarray(predicates.intersects(dev, dev))
+    assert got.diagonal().all()
+    # square [0,4]^2 vs 10x10-with-hole overlap
+    assert got[0, 1]
+
+
+def test_disjoint_squares():
+    col = wkt.from_wkt(
+        ["POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))", "POLYGON ((5 5, 6 5, 6 6, 5 6, 5 5))"]
+    )
+    dev = pack_to_device(col, dtype=jnp.float64)
+    got = np.asarray(predicates.intersects(dev, dev))
+    assert not got[0, 1] and not got[1, 0]
+    d = np.asarray(predicates.min_distance(dev, dev))
+    np.testing.assert_allclose(d[0, 1], np.sqrt(32), rtol=1e-9)
+
+
+def test_point_distance(dev):
+    pts = jnp.array([[2.0, 2.0], [-3.0, 0.0]])
+    d = np.asarray(predicates.points_min_dist(pts, dev))
+    assert d[0, 0] == 0.0  # inside square
+    np.testing.assert_allclose(d[1, 0], 3.0)  # 3 left of x=0 edge
+
+
+# ------------------------------------------------------------------- pallas
+def test_pallas_pip_matches_reference(polys, dev):
+    pts = jnp.asarray(fx.random_points(777, bbox=(-1, -2, 11, 11), seed=4))
+    planes, n_g = pip.edge_planes(dev)
+    got = np.asarray(
+        pip.pip_zone(pts, planes, n_g, tile_n=256, tile_e=8, interpret=True)
+    )
+    want = np.asarray(pip.pip_zone_reference(pts, dev))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_pip_unaligned_n(dev):
+    pts = jnp.asarray(fx.random_points(100, bbox=(-1, -2, 11, 11), seed=5))
+    planes, n_g = pip.edge_planes(dev)
+    got = np.asarray(
+        pip.pip_zone(pts, planes, n_g, tile_n=256, tile_e=8, interpret=True)
+    )
+    assert got.shape == (100,)
+    want = np.asarray(pip.pip_zone_reference(pts, dev))
+    np.testing.assert_array_equal(got, want)
