@@ -1,0 +1,290 @@
+"""Vectorized H3 core math: gnomonic face projections, aperture-7 hex grid.
+
+Implements the published H3 grid algorithm (geo <-> face IJK <-> cell id)
+from the spec's orientation constants, fully vectorized over numpy/jax
+arrays. Works identically under numpy (host, table derivation in tables.py)
+and jax.numpy (device hot path) — the array namespace is a parameter.
+
+Reference analog: the H3 C core the reference calls through JNI
+(`core/index/H3IndexSystem.scala:27`, `pointToIndex` :140-142).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import constants as C
+
+_EPS = 1e-12
+
+
+# --------------------------------------------------------------------- geo
+def geo_to_vec3(lat, lng, xp=np):
+    cl = xp.cos(lat)
+    return xp.stack([cl * xp.cos(lng), cl * xp.sin(lng), xp.sin(lat)], axis=-1)
+
+
+_FACE_CENTER_VEC3 = geo_to_vec3(
+    C.FACE_CENTER_GEO[:, 0], C.FACE_CENTER_GEO[:, 1]
+)  # (20, 3)
+
+
+def geo_azimuth(lat1, lng1, lat2, lng2, xp=np):
+    return xp.arctan2(
+        xp.cos(lat2) * xp.sin(lng2 - lng1),
+        xp.cos(lat1) * xp.sin(lat2)
+        - xp.sin(lat1) * xp.cos(lat2) * xp.cos(lng2 - lng1),
+    )
+
+
+def geo_az_distance(lat, lng, az, r, xp=np):
+    """Point at azimuth az and angular distance r from (lat, lng)."""
+    sinlat = xp.sin(lat) * xp.cos(r) + xp.cos(lat) * xp.sin(r) * xp.cos(az)
+    sinlat = xp.clip(sinlat, -1.0, 1.0)
+    lat2 = xp.arcsin(sinlat)
+    y = xp.sin(az) * xp.sin(r) * xp.cos(lat)
+    x = xp.cos(r) - xp.sin(lat) * sinlat
+    lng2 = lng + xp.arctan2(y, x)
+    small = r < _EPS
+    return xp.where(small, lat, lat2), xp.where(small, lng, lng2)
+
+
+def pos_angle(a, xp=np):
+    tau = 2.0 * np.pi
+    return xp.where(a < 0, a + tau * xp.ceil(-a / tau), a % tau)
+
+
+# --------------------------------------------------------------------- ijk
+def ijk_normalize(i, j, k, xp=np):
+    m = xp.minimum(xp.minimum(i, j), k)
+    return i - m, j - m, k - m
+
+
+def ijk_to_hex2d(i, j, k, xp=np):
+    ii = i - k
+    jj = j - k
+    x = ii - 0.5 * jj
+    y = jj * C.SIN60
+    return x, y
+
+
+def hex2d_to_ijk(x, y, xp=np):
+    """Nearest hex center (cube-coordinate rounding). Returns normalized
+    non-negative (i, j, k) int64."""
+    jj = y / C.SIN60
+    ii = x + 0.5 * jj
+    # cube coords (q, r, s) = (ii, jj, -ii-jj)
+    q, r, s = ii, jj, -ii - jj
+    rq = xp.round(q)
+    rr = xp.round(r)
+    rs = xp.round(s)
+    dq = xp.abs(rq - q)
+    dr = xp.abs(rr - r)
+    ds = xp.abs(rs - s)
+    # fix the coordinate with the largest rounding error
+    fix_q = (dq > dr) & (dq > ds)
+    fix_r = ~fix_q & (dr > ds)
+    rq = xp.where(fix_q, -rr - rs, rq)
+    rr = xp.where(fix_r, -rq - rs, rr)
+    i = rq.astype(np.int64 if xp is np else xp.int64)
+    j = rr.astype(np.int64 if xp is np else xp.int64)
+    k = xp.zeros_like(i)
+    return ijk_normalize(i, j, k, xp)
+
+
+def hex2d_to_axial(x, y, xp=np):
+    """Nearest hex center in *unnormalized* axial coords (q, r) — needed for
+    grid distance where the k=0 plane offset matters."""
+    jj = y / C.SIN60
+    ii = x + 0.5 * jj
+    q, r, s = ii, jj, -ii - jj
+    rq = xp.round(q)
+    rr = xp.round(r)
+    rs = xp.round(s)
+    dq = xp.abs(rq - q)
+    dr = xp.abs(rr - r)
+    ds = xp.abs(rs - s)
+    fix_q = (dq > dr) & (dq > ds)
+    fix_r = ~fix_q & (dr > ds)
+    rq = xp.where(fix_q, -rr - rs, rq)
+    rr = xp.where(fix_r, -rq - rs, rr)
+    return rq.astype(np.int64), rr.astype(np.int64)
+
+
+def up_ap7(i, j, k, xp=np):
+    """Class III (ccw) aperture-7 parent."""
+    ii = i - k
+    jj = j - k
+    ni = xp.round((3 * ii - jj) / 7.0).astype(i.dtype)
+    nj = xp.round((ii + 2 * jj) / 7.0).astype(i.dtype)
+    return ijk_normalize(ni, nj, xp.zeros_like(ni), xp)
+
+
+def up_ap7r(i, j, k, xp=np):
+    """Class II (cw) aperture-7 parent."""
+    ii = i - k
+    jj = j - k
+    ni = xp.round((2 * ii + jj) / 7.0).astype(i.dtype)
+    nj = xp.round((3 * jj - ii) / 7.0).astype(i.dtype)
+    return ijk_normalize(ni, nj, xp.zeros_like(ni), xp)
+
+
+def down_ap7(i, j, k, xp=np):
+    """Scale finer, Class III: i->(3,0,1), j->(1,3,0), k->(0,1,3)."""
+    ni = 3 * i + 1 * j + 0 * k
+    nj = 0 * i + 3 * j + 1 * k
+    nk = 1 * i + 0 * j + 3 * k
+    return ijk_normalize(ni, nj, nk, xp)
+
+
+def down_ap7r(i, j, k, xp=np):
+    """Scale finer, Class II: i->(3,1,0), j->(0,3,1), k->(1,0,3)."""
+    ni = 3 * i + 0 * j + 1 * k
+    nj = 1 * i + 3 * j + 0 * k
+    nk = 0 * i + 1 * j + 3 * k
+    return ijk_normalize(ni, nj, nk, xp)
+
+
+def ijk_add_digit(i, j, k, digit, xp=np):
+    uv = C.UNIT_VECS if xp is np else xp.asarray(C.UNIT_VECS)
+    step = uv[digit]
+    return ijk_normalize(i + step[..., 0], j + step[..., 1], k + step[..., 2], xp)
+
+
+def unit_ijk_to_digit(i, j, k, xp=np):
+    """Normalized unit ijk -> digit 0..6 (7 if not a unit vector)."""
+    digit = xp.full(i.shape, C.INVALID_DIGIT, dtype=np.int64)
+    uv = C.UNIT_VECS if xp is np else xp.asarray(C.UNIT_VECS)
+    for d in range(7):
+        hit = (i == uv[d, 0]) & (j == uv[d, 1]) & (k == uv[d, 2])
+        digit = xp.where(hit, d, digit)
+    return digit
+
+
+def is_class_iii(res) -> bool:
+    return bool(res % 2)
+
+
+# ---------------------------------------------------------- face projection
+def nearest_face(lat, lng, xp=np):
+    """Face whose center is closest (max dot product). (...,) int."""
+    v = geo_to_vec3(lat, lng, xp)  # (...,3)
+    fc = _FACE_CENTER_VEC3 if xp is np else xp.asarray(_FACE_CENTER_VEC3)
+    dots = v @ fc.T  # (...,20)
+    return xp.argmax(dots, axis=-1), xp.clip(xp.max(dots, axis=-1), -1.0, 1.0)
+
+
+def geo_to_hex2d(lat, lng, res: int, face=None, xp=np):
+    """Project geo onto a face's gnomonic plane in res-scaled hex units.
+
+    If ``face`` is None the nearest face is used (returned alongside x, y).
+    """
+    if face is None:
+        face, cosdist = nearest_face(lat, lng, xp)
+        r = xp.arccos(cosdist)
+    else:
+        fc_geo = C.FACE_CENTER_GEO if xp is np else xp.asarray(C.FACE_CENTER_GEO)
+        flat, flng = fc_geo[face, 0], fc_geo[face, 1]
+        v = geo_to_vec3(lat, lng, xp)
+        fv = geo_to_vec3(flat, flng, xp)
+        r = xp.arccos(xp.clip(xp.sum(v * fv, axis=-1), -1.0, 1.0))
+    fc_geo = C.FACE_CENTER_GEO if xp is np else xp.asarray(C.FACE_CENTER_GEO)
+    az_i = C.FACE_AXES_AZ_I if xp is np else xp.asarray(C.FACE_AXES_AZ_I)
+    flat, flng = fc_geo[face, 0], fc_geo[face, 1]
+    az = geo_azimuth(flat, flng, lat, lng, xp)
+    theta = pos_angle(az_i[face] - pos_angle(az, xp), xp)
+    if is_class_iii(res):
+        theta = pos_angle(theta - C.AP7_ROT_RADS, xp)
+    rr = xp.tan(r) / C.RES0_U_GNOMONIC
+    rr = rr * (C.SQRT7 ** res)
+    x = rr * xp.cos(theta)
+    y = rr * xp.sin(theta)
+    return face, x, y
+
+
+def hex2d_to_geo(face, x, y, res: int, substrate: bool = False, xp=np):
+    """Inverse gnomonic: res-scaled hex coords on a face -> (lat, lng)."""
+    r = xp.sqrt(x * x + y * y)
+    theta = xp.arctan2(y, x)
+    r = r / (C.SQRT7 ** res)
+    if substrate:
+        r = r / 3.0
+        if is_class_iii(res):
+            r = r / C.SQRT7
+    r = xp.arctan(r * C.RES0_U_GNOMONIC)
+    if not substrate and is_class_iii(res):
+        theta = pos_angle(theta + C.AP7_ROT_RADS, xp)
+    az_i = C.FACE_AXES_AZ_I if xp is np else xp.asarray(C.FACE_AXES_AZ_I)
+    fc_geo = C.FACE_CENTER_GEO if xp is np else xp.asarray(C.FACE_CENTER_GEO)
+    az = pos_angle(az_i[face] - pos_angle(theta, xp), xp)
+    return geo_az_distance(fc_geo[face, 0], fc_geo[face, 1], az, r, xp)
+
+
+# ----------------------------------------------------------- index packing
+def pack(base_cell, digits, res: int, xp=np):
+    """base_cell (N,), digits (N, 15) with INVALID(7) padding -> H3 ids."""
+    h = (
+        (np.int64(C.MODE_CELL) << C.MODE_OFFSET)
+        | (xp.asarray(res).astype(np.int64) << C.RES_OFFSET)
+        | (base_cell.astype(np.int64) << C.BASE_CELL_OFFSET)
+    )
+    for r in range(C.MAX_RES):
+        shift = (C.MAX_RES - 1 - r) * C.PER_DIGIT_OFFSET
+        h = h | (digits[..., r].astype(np.int64) << shift)
+    return h
+
+
+def unpack(h, xp=np):
+    """H3 ids -> (res, base_cell, digits (N,15))."""
+    h = h.astype(np.int64) if xp is np else h.astype(xp.int64)
+    res = (h >> C.RES_OFFSET) & 0xF
+    base_cell = (h >> C.BASE_CELL_OFFSET) & 0x7F
+    digits = xp.stack(
+        [
+            (h >> ((C.MAX_RES - 1 - r) * C.PER_DIGIT_OFFSET)) & C.DIGIT_MASK
+            for r in range(C.MAX_RES)
+        ],
+        axis=-1,
+    )
+    return res, base_cell, digits
+
+
+def leading_nonzero_digit(digits, res, xp=np):
+    """First non-CENTER digit among digits[..., :res] (0 if none)."""
+    out = xp.zeros(digits.shape[:-1], dtype=np.int64)
+    found = xp.zeros(digits.shape[:-1], dtype=bool)
+    for r in range(C.MAX_RES):
+        d = digits[..., r]
+        active = (r < res) & ~found & (d != 0)
+        out = xp.where(active, d, out)
+        found = found | ((r < res) & (d != 0))
+    return out
+
+
+def rotate_digits(digits, res, table, xp=np):
+    """Apply a digit-wise 60-degree rotation to digits[..., :res]."""
+    tab = table if xp is np else xp.asarray(table)
+    rotated = tab[digits]
+    r_idx = np.arange(C.MAX_RES)
+    mask = (r_idx[None, :] < xp.asarray(res)[..., None]) if np.ndim(res) else (
+        r_idx < res
+    )
+    return xp.where(mask, rotated, digits)
+
+
+def rotate60_ccw(digits, res, xp=np):
+    return rotate_digits(digits, res, C.ROT60_CCW, xp)
+
+
+def rotate60_cw(digits, res, xp=np):
+    return rotate_digits(digits, res, C.ROT60_CW, xp)
+
+
+def rotate_pent60_ccw(digits, res, xp=np):
+    """Pentagon ccw rotation: rotate digits, skipping the K-axis 'deleted'
+    subsequence — if the leading digit lands on K, rotate once more."""
+    rotated = rotate60_ccw(digits, res, xp)
+    lead = leading_nonzero_digit(rotated, res, xp)
+    again = rotate60_ccw(rotated, res, xp)
+    need = lead == C.K_AXES_DIGIT
+    return xp.where(need[..., None], again, rotated)

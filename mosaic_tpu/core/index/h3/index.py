@@ -1,0 +1,224 @@
+"""H3IndexSystem: the IndexSystem contract over the from-scratch H3 core.
+
+Reference analog: `core/index/H3IndexSystem.scala:22-221` (which calls the
+H3 C core over JNI per row). Here `point_to_cell` is one fused array program
+(numpy on host, jax.numpy under jit on device) — the billion-point
+`grid_longlatascellid` hot path of SURVEY.md §3.4.
+
+Coordinates are (lng, lat) degrees in xy order, matching GeoJSON and the
+rest of the framework.
+
+Known round-1 limitations (documented; affect only the 12 pentagon base
+cells — remote ocean/polar areas): pentagon digit adjustment is imperfect
+(~15% of pentagon-area points fail the cell->center->cell round trip),
+pentagon boundaries are emitted with 6 vertices, and neighbor stepping near
+pentagon distortion may skip a cell.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import IndexSystem
+from . import constants as C
+from . import core
+from . import hexmath as hm
+from .tables import derive
+
+
+def _cell_radius_rad(res: int) -> float:
+    """Approximate hexagon circumradius in radians at a resolution."""
+    return float(np.arctan(C.RES0_U_GNOMONIC / np.sqrt(3.0) / (C.SQRT7**res)))
+
+
+class H3IndexSystem(IndexSystem):
+    name = "H3"
+    boundary_max_verts = 7  # 6 + closing vertex
+
+    def resolutions(self) -> Sequence[int]:
+        return list(range(C.MAX_RES + 1))
+
+    def resolution_of(self, cells) -> jax.Array:
+        xp = jnp if isinstance(cells, jax.Array) else np
+        return core.resolution(xp.asarray(cells), xp).astype(xp.int32)
+
+    def buffer_radius(self, resolution: int) -> float:
+        return float(np.degrees(_cell_radius_rad(resolution)))
+
+    def cell_area_approx(self, resolution: int) -> float:
+        """Mean cell area in square degrees (CRS units of EPSG:4326)."""
+        sphere_sq_deg = 4 * np.pi * (180 / np.pi) ** 2
+        n_cells = 2 + 120 * (7**resolution)
+        return float(sphere_sq_deg / n_cells)
+
+    # ---------------------------------------------------------------- core
+    def point_to_cell(self, xy, resolution: int) -> jax.Array:
+        xp = jnp if isinstance(xy, jax.Array) else np
+        xy = xp.asarray(xy)
+        lng = xp.radians(xy[..., 0])
+        lat = xp.radians(xy[..., 1])
+        return core.geo_to_cell(lat, lng, resolution, xp)
+
+    def cell_center(self, cells) -> jax.Array:
+        xp = jnp if isinstance(cells, jax.Array) else np
+        cells = xp.asarray(cells)
+        lat, lng = core.cell_to_geo(cells, xp)
+        return xp.stack([xp.degrees(lng), xp.degrees(lat)], axis=-1)
+
+    def cell_boundary(self, cells) -> jax.Array:
+        xp = jnp if isinstance(cells, jax.Array) else np
+        cells = xp.asarray(cells)
+        lats, lngs = core.cell_boundary(cells, xp)
+        # close the ring: repeat first vertex
+        lats = xp.concatenate([lats, lats[..., :1]], axis=-1)
+        lngs = xp.concatenate([lngs, lngs[..., :1]], axis=-1)
+        return xp.stack([xp.degrees(lngs), xp.degrees(lats)], axis=-1)
+
+    def is_valid(self, cells) -> jax.Array:
+        xp = jnp if isinstance(cells, jax.Array) else np
+        return core.is_valid_cell(xp.asarray(cells), xp)
+
+    def is_pentagon(self, cells) -> jax.Array:
+        xp = jnp if isinstance(cells, jax.Array) else np
+        return core.is_pentagon_cell(xp.asarray(cells), xp)
+
+    # ----------------------------------------------------------- neighbors
+    def neighbors(self, cells) -> np.ndarray:
+        """(N,) -> (N, 6) adjacent cells (edge-sharing), -1 pads for
+        pentagons/duplicates.
+
+        Table-free: steps from each cell center past each edge midpoint in
+        the owning face's exact grid frame, then re-rounds — the geometric
+        equivalent of the C library's h3NeighborRotations tables.
+        """
+        xp = np
+        cells = np.asarray(cells, dtype=np.int64)
+        face, i, j, k, res_arr = core.cell_to_owned_fijk(cells, xp)
+        cx, cy = hm.ijk_to_hex2d(
+            i.astype(float), j.astype(float), k.astype(float), xp
+        )
+        out = np.full((len(cells), 6), -1, dtype=np.int64)
+        for m in range(6):
+            ang = m * np.pi / 3
+            nx = cx + np.cos(ang)
+            ny = cy + np.sin(ang)
+            lat, lng = core._per_res_geo(face, nx, ny, res_arr, xp)
+            ncell = np.full(len(cells), -1, dtype=np.int64)
+            for r in np.unique(res_arr):
+                sel = res_arr == r
+                ncell[sel] = core.geo_to_cell(lat[sel], lng[sel], int(r), xp)
+            out[:, m] = ncell
+        # dedupe per row (pentagon neighbors can repeat), drop self
+        for row in range(out.shape[0]):
+            seen = {int(cells[row])}
+            for m in range(6):
+                v = int(out[row, m])
+                if v in seen:
+                    out[row, m] = -1
+                else:
+                    seen.add(v)
+        return out
+
+    def k_ring(self, cells, k: int) -> np.ndarray:
+        """(N,) -> (N, 1+3k(k+1)) filled disk (host BFS over neighbors)."""
+        cells = np.asarray(cells, dtype=np.int64)
+        m_out = 1 + 3 * k * (k + 1)
+        disk = [set([int(c)]) for c in cells]
+        frontier = cells.copy()
+        frontier_sets = [set([int(c)]) for c in cells]
+        for _ in range(k):
+            next_sets = [set() for _ in cells]
+            flat = sorted({c for s in frontier_sets for c in s})
+            if not flat:
+                break
+            flat_arr = np.asarray(flat, dtype=np.int64)
+            nbrs = self.neighbors(flat_arr)
+            nbr_map = {int(c): nbrs[i] for i, c in enumerate(flat_arr)}
+            for row in range(len(cells)):
+                for c in frontier_sets[row]:
+                    for v in nbr_map[c]:
+                        v = int(v)
+                        if v >= 0 and v not in disk[row]:
+                            next_sets[row].add(v)
+                disk[row] |= next_sets[row]
+            frontier_sets = next_sets
+        out = np.full((len(cells), m_out), -1, dtype=np.int64)
+        for row in range(len(cells)):
+            vals = sorted(disk[row])
+            out[row, : len(vals)] = vals[:m_out]
+        return out
+
+    def k_loop(self, cells, k: int) -> np.ndarray:
+        """Hollow ring: k_ring(k) minus k_ring(k-1)."""
+        cells = np.asarray(cells, dtype=np.int64)
+        full = self.k_ring(cells, k)
+        if k == 0:
+            return full
+        inner = self.k_ring(cells, k - 1)
+        m_out = 6 * k
+        out = np.full((len(cells), m_out), -1, dtype=np.int64)
+        for row in range(len(cells)):
+            inn = set(int(v) for v in inner[row] if v >= 0)
+            vals = [int(v) for v in full[row] if v >= 0 and int(v) not in inn]
+            out[row, : len(vals)] = vals[:m_out]
+        return out
+
+    def grid_distance(self, cells_a, cells_b) -> np.ndarray:
+        """Hex grid distance via planar ijk on a common face projection.
+
+        Exact when both cells project onto one face; across faces/pentagons
+        the unfolded estimate can deviate (documented limitation; the
+        reference's h3Distance has the same failure mode and returns -1)."""
+        xp = np
+        a = np.asarray(cells_a, dtype=np.int64)
+        b = np.asarray(cells_b, dtype=np.int64)
+        lat_a, lng_a = core.cell_to_geo(a, xp)
+        lat_b, lng_b = core.cell_to_geo(b, xp)
+        res_arr = core.resolution(a, xp)
+        face, _ = hm.nearest_face(
+            (lat_a + lat_b) / 2, (lng_a + lng_b) / 2, xp
+        )  # midpoint face
+        out = np.zeros(len(a), dtype=np.int64)
+        for r in np.unique(res_arr):
+            sel = res_arr == r
+            _, xa, ya = hm.geo_to_hex2d(lat_a[sel], lng_a[sel], int(r), face=face[sel])
+            _, xb, yb = hm.geo_to_hex2d(lat_b[sel], lng_b[sel], int(r), face=face[sel])
+            ia, ja = hm.hex2d_to_axial(xa, ya)
+            ib, jb = hm.hex2d_to_axial(xb, yb)
+            di = ia - ib
+            dj = ja - jb
+            # hex distance in the (i at 0deg, j at 120deg) basis where the
+            # six unit steps are +-(1,0), +-(0,1), +-(1,1)
+            out[sel] = np.maximum.reduce(
+                [np.abs(di), np.abs(dj), np.abs(di - dj)]
+            )
+        return out
+
+    # ------------------------------------------------------------ polyfill
+    def polyfill_candidates(self, bounds: np.ndarray, resolution: int) -> np.ndarray:
+        """Sample-grid candidates covering a lng/lat bbox, plus a 1-ring."""
+        rad = np.degrees(_cell_radius_rad(resolution))
+        lat_mid = np.clip((bounds[1] + bounds[3]) / 2, -89.0, 89.0)
+        step_lat = max(rad * 0.8, 1e-7)
+        step_lng = max(rad * 0.8 / max(np.cos(np.radians(lat_mid)), 0.05), 1e-7)
+        xs = np.arange(bounds[0] - step_lng, bounds[2] + 2 * step_lng, step_lng)
+        ys = np.arange(bounds[1] - step_lat, bounds[3] + 2 * step_lat, step_lat)
+        ys = ys[(ys >= -90) & (ys <= 90)]
+        gx, gy = np.meshgrid(xs, ys, indexing="ij")
+        pts = np.stack([gx.ravel(), gy.ravel()], axis=-1)
+        if pts.size == 0:
+            return np.zeros(0, np.int64)
+        cells = np.unique(self.point_to_cell(pts, resolution))
+        ring = self.k_ring(cells, 1)
+        return np.unique(ring[ring >= 0])
+
+    # ------------------------------------------------------------- strings
+    def format(self, cells: np.ndarray) -> list[str]:
+        return ["%x" % int(c) for c in np.asarray(cells)]
+
+    def parse(self, strs: Sequence[str]) -> np.ndarray:
+        return np.asarray([int(s, 16) for s in strs], dtype=np.int64)

@@ -1,0 +1,312 @@
+"""Geometric derivation of the H3 base-cell tables.
+
+The H3 C library ships hand-laid lookup tables (baseCellData,
+faceIjkBaseCells, baseCellNeighbors). We do NOT transcribe them: everything
+is *derived* at import time from the published orientation constants in
+`constants.py`:
+
+- Res-0 cell positions: on each icosahedron face (maxDim 2 at res 0) the
+  valid cells are the 10 normalized ijk with i+j+k <= 2 — 1 face center,
+  3 interior cells, 3 edge midpoints (shared by 2 faces), 3 corners
+  (icosahedron vertices, shared by 5 faces => pentagons).
+  20 + 60 + 30 + 12 = 122 unique base cells.
+- Numbering: H3 numbers base cells by descending latitude; we sort and
+  verify the 12 pentagons land exactly at the published pentagon numbers
+  {4,14,24,38,49,58,63,72,83,97,107,117} — a 12-point check that the
+  derived numbering matches the spec.
+- Home face: the lowest face index on which the cell appears.
+- Per-appearance ccw 60-degree rotation: calibrated by projecting a small
+  step along the home face's i-axis into the observed face's frame and
+  quantizing the angle.
+
+Derivation cost: ~200 projections — microseconds, done once lazily.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from . import constants as C
+from . import hexmath as hm
+
+PENTAGON_IDS = frozenset({4, 14, 24, 38, 49, 58, 63, 72, 83, 97, 107, 117})
+
+# the 10 valid normalized res-0 ijk positions per face
+_RES0_IJK = np.array(
+    [
+        [0, 0, 0],
+        [1, 0, 0],
+        [0, 1, 0],
+        [0, 0, 1],
+        [1, 1, 0],
+        [1, 0, 1],
+        [0, 1, 1],
+        [2, 0, 0],
+        [0, 2, 0],
+        [0, 0, 2],
+    ],
+    dtype=np.int64,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class BaseCellTables:
+    # per base cell (122,)
+    home_face: np.ndarray  # int
+    home_ijk: np.ndarray  # (122, 3)
+    is_pentagon: np.ndarray  # bool
+    center_geo: np.ndarray  # (122, 2) lat,lng radians
+    # lookup (20, 3, 3, 3): base cell number or -1
+    fijk_base_cell: np.ndarray
+    # lookup (20, 3, 3, 3): ccw 60deg rotations home->face
+    fijk_ccw_rot60: np.ndarray
+    # pentagon cw-offset faces (122, 2): faces where the pentagon's grid is
+    # clockwise-offset from the home system; -1 padding for hexagons
+    pent_cw_faces: np.ndarray
+    # per face, per edge e (between corner e and corner (e+1)%3):
+    # neighboring face (20, 3), ccw rotation steps (20, 3), and res-0 hex2d
+    # translation (20, 3, 2) of the rigid unfold transform f-frame -> g-frame
+    edge_neighbor_face: np.ndarray = None
+    edge_rot60: np.ndarray = None
+    edge_translate: np.ndarray = None
+    # (20, 3, 2): canonical corner index (0..2) on the NEIGHBOR face of edge
+    # endpoints A (corner e) and B (corner (e+1)%3)
+    edge_corner_idx: np.ndarray = None
+
+
+def _appearance_geo():
+    """All (face, ijk) res-0 appearances with their geo/vec3 positions."""
+    faces = np.repeat(np.arange(C.NUM_FACES), len(_RES0_IJK))
+    ijk = np.tile(_RES0_IJK, (C.NUM_FACES, 1))
+    x, y = hm.ijk_to_hex2d(
+        ijk[:, 0].astype(float), ijk[:, 1].astype(float), ijk[:, 2].astype(float)
+    )
+    lat, lng = hm.hex2d_to_geo(faces, x, y, res=0)
+    vec = hm.geo_to_vec3(lat, lng)
+    return faces, ijk, lat, lng, vec
+
+
+@functools.lru_cache(maxsize=1)
+def derive() -> BaseCellTables:
+    faces, ijk, lat, lng, vec = _appearance_geo()
+    n = len(faces)
+    # cluster appearances into unique cells
+    cell_of = np.full(n, -1)
+    uniq_vec: list[np.ndarray] = []
+    uniq_members: list[list[int]] = []
+    for a in range(n):
+        found = -1
+        for u, uv in enumerate(uniq_vec):
+            if float(vec[a] @ uv) > 1 - 1e-9:
+                found = u
+                break
+        if found < 0:
+            uniq_vec.append(vec[a])
+            uniq_members.append([a])
+            found = len(uniq_vec) - 1
+        else:
+            uniq_members[found].append(a)
+        cell_of[a] = found
+    assert len(uniq_vec) == C.NUM_BASE_CELLS, len(uniq_vec)
+
+    # number by descending latitude (verified via the pentagon anchor check)
+    uniq_lat = np.array([lat[m[0]] for m in uniq_members])
+    order = np.argsort(-uniq_lat, kind="stable")
+    renum = np.empty_like(order)
+    renum[order] = np.arange(len(order))
+
+    home_face = np.full(C.NUM_BASE_CELLS, -1, dtype=np.int64)
+    home_ijk = np.zeros((C.NUM_BASE_CELLS, 3), dtype=np.int64)
+    is_pent = np.zeros(C.NUM_BASE_CELLS, dtype=bool)
+    center_geo = np.zeros((C.NUM_BASE_CELLS, 2))
+    fijk_bc = np.full((C.NUM_FACES, 3, 3, 3), -1, dtype=np.int64)
+    fijk_rot = np.zeros((C.NUM_FACES, 3, 3, 3), dtype=np.int64)
+
+    for u, members in enumerate(uniq_members):
+        b = int(renum[u])
+        is_pent[b] = len(members) == 5
+        # home face: lowest face index
+        mf = [(int(faces[a]), a) for a in members]
+        mf.sort()
+        home_a = mf[0][1]
+        home_face[b] = faces[home_a]
+        home_ijk[b] = ijk[home_a]
+        center_geo[b] = (lat[home_a], lng[home_a])
+
+    pent_numbers = sorted(np.nonzero(is_pent)[0].tolist())
+    if pent_numbers != sorted(PENTAGON_IDS):
+        raise AssertionError(
+            f"derived base-cell numbering does not match the H3 spec: "
+            f"pentagons at {pent_numbers}"
+        )
+
+    # per-appearance rotation calibration
+    step = 0.15
+    for u, members in enumerate(uniq_members):
+        b = int(renum[u])
+        hf = int(home_face[b])
+        hijk = home_ijk[b].astype(float)
+        hx, hy = hm.ijk_to_hex2d(hijk[0], hijk[1], hijk[2])
+        # geo of a small step along the home i-axis
+        slat, slng = hm.hex2d_to_geo(np.int64(hf), hx + step, hy, res=0)
+        for a in members:
+            f = int(faces[a])
+            i, j, k = (int(v) for v in ijk[a])
+            ox, oy = hm.ijk_to_hex2d(float(i), float(j), float(k))
+            _, px, py = hm.geo_to_hex2d(
+                np.asarray(slat), np.asarray(slng), res=0, face=np.int64(f)
+            )
+            ang = np.arctan2(float(py) - oy, float(px) - ox)
+            rot = int(np.round(ang / (np.pi / 3))) % 6
+            fijk_bc[f, i, j, k] = b
+            fijk_rot[f, i, j, k] = (6 - rot) % 6
+
+    # pentagon cw-offset faces: the two appearance faces whose calibrated
+    # rotation is "odd" relative to the pentagon's 5-sector symmetry. A
+    # pentagon has 5 appearances with rotations {r0..r4}; on the icosahedron
+    # exactly two of the five faces meet the vertex such that the projected
+    # i-axis winds clockwise. We detect them via the rotation parity of the
+    # face ring around the vertex.
+    pent_cw = np.full((C.NUM_BASE_CELLS, 2), -1, dtype=np.int64)
+    for u, members in enumerate(uniq_members):
+        b = int(renum[u])
+        if not is_pent[b]:
+            continue
+        rots = {}
+        for a in members:
+            f = int(faces[a])
+            i, j, k = (int(v) for v in ijk[a])
+            rots[f] = int(fijk_rot[f, i, j, k])
+        # faces with rotation that is NOT expressible as a pentagon rotation
+        # (multiples of 72deg quantized on the 60deg lattice cover rotations
+        # {0,1,2,4,5} differently); empirically the cw-offset faces are the
+        # ones whose observed rotation relative to home is 'behind' the ring.
+        # Round-1 heuristic: mark the two faces with the largest rotation.
+        order_f = sorted(rots.items(), key=lambda kv: kv[1], reverse=True)
+        pent_cw[b, 0] = order_f[0][0]
+        pent_cw[b, 1] = order_f[1][0]
+
+    edge_nf, edge_rot, edge_t, edge_cidx = _add_overage_entries(
+        faces, ijk, cell_of, renum, uniq_members, fijk_bc, fijk_rot
+    )
+
+    return BaseCellTables(
+        home_face=home_face,
+        home_ijk=home_ijk,
+        is_pentagon=is_pent,
+        center_geo=center_geo,
+        fijk_base_cell=fijk_bc,
+        fijk_ccw_rot60=fijk_rot,
+        pent_cw_faces=pent_cw,
+        edge_neighbor_face=edge_nf,
+        edge_rot60=edge_rot,
+        edge_translate=edge_t,
+        edge_corner_idx=edge_cidx,
+    )
+
+
+# overage res-0 positions: normalized ijk with min==0 and 2 < i+j+k <= 4 —
+# cells whose hexagons straddle an icosahedron edge, reachable by rounding
+# from points inside the face triangle
+_OVERAGE_IJK = np.array(
+    [
+        [2, 1, 0],
+        [2, 0, 1],
+        [1, 2, 0],
+        [0, 2, 1],
+        [1, 0, 2],
+        [0, 1, 2],
+        [2, 2, 0],
+        [2, 0, 2],
+        [0, 2, 2],
+    ],
+    dtype=np.int64,
+)
+
+_CORNER_IJK = np.array([[2, 0, 0], [0, 2, 0], [0, 0, 2]], dtype=np.int64)
+
+
+def _add_overage_entries(faces, ijk, cell_of, renum, uniq_members, fijk_bc, fijk_rot):
+    """Fill table entries for positions past each face's triangle by planar
+    unfolding across the shared edge (the role of the C library's
+    faceNeighbors table, derived instead of transcribed), and record the
+    per-edge rigid transforms for runtime lattice unfolding.
+
+    The rigid transform f-frame -> g-frame is fixed by the two shared
+    icosahedron vertices: both appear at known corner ijk on both faces.
+    """
+
+    def hex2d(v):
+        x, y = hm.ijk_to_hex2d(float(v[0]), float(v[1]), float(v[2]))
+        return np.array([x, y])
+
+    app = {}
+    for a in range(len(faces)):
+        app[(int(faces[a]), tuple(int(v) for v in ijk[a]))] = int(cell_of[a])
+
+    vert_faces: dict[int, list[tuple[int, np.ndarray]]] = {}
+    for f in range(C.NUM_FACES):
+        for cijk in _CORNER_IJK:
+            u = app[(f, tuple(int(v) for v in cijk))]
+            vert_faces.setdefault(u, []).append((f, cijk))
+
+    valid_set = {tuple(int(v) for v in q) for q in _RES0_IJK}
+    edge_nf = np.full((C.NUM_FACES, 3), -1, dtype=np.int64)
+    edge_rot = np.zeros((C.NUM_FACES, 3), dtype=np.int64)
+    edge_t = np.zeros((C.NUM_FACES, 3, 2))
+    edge_cidx = np.zeros((C.NUM_FACES, 3, 2), dtype=np.int64)
+
+    def corner_index(v):
+        for m, cv in enumerate(_CORNER_IJK):
+            if np.array_equal(v, cv):
+                return m
+        raise AssertionError(v)
+
+    for f in range(C.NUM_FACES):
+        corners = [
+            (app[(f, tuple(int(v) for v in cijk))], cijk) for cijk in _CORNER_IJK
+        ]
+        for e in range(3):
+            (ua, ijk_a), (ub, ijk_b) = corners[e], corners[(e + 1) % 3]
+            shared = [
+                g
+                for g, _ in vert_faces[ua]
+                if g != f and any(g2 == g for g2, _ in vert_faces[ub])
+            ]
+            if not shared:
+                continue
+            g = shared[0]
+            gijk_a = next(v for g2, v in vert_faces[ua] if g2 == g)
+            gijk_b = next(v for g2, v in vert_faces[ub] if g2 == g)
+            a1, a2 = hex2d(ijk_a), hex2d(ijk_b)
+            b1, b2 = hex2d(gijk_a), hex2d(gijk_b)
+            ang = np.arctan2(*(b2 - b1)[::-1]) - np.arctan2(*(a2 - a1)[::-1])
+            n_rot = int(np.round(ang / (np.pi / 3))) % 6
+            th = n_rot * np.pi / 3
+            R = np.array([[np.cos(th), -np.sin(th)], [np.sin(th), np.cos(th)]])
+            t = b1 - R @ a1
+            edge_nf[f, e] = g
+            edge_rot[f, e] = n_rot
+            edge_t[f, e] = t
+            edge_cidx[f, e, 0] = corner_index(gijk_a)
+            edge_cidx[f, e, 1] = corner_index(gijk_b)
+            for p in _OVERAGE_IJK:
+                if fijk_bc[f, p[0], p[1], p[2]] >= 0:
+                    continue
+                pp = R @ hex2d(p) + t
+                pi, pj, pk = hm.hex2d_to_ijk(
+                    np.asarray(pp[0]), np.asarray(pp[1])
+                )
+                key = (g, (int(pi), int(pj), int(pk)))
+                if key[1] in valid_set:
+                    u = app[key]
+                    b = int(renum[u])
+                    base_rot = int(
+                        fijk_rot[g, key[1][0], key[1][1], key[1][2]]
+                    )
+                    fijk_bc[f, p[0], p[1], p[2]] = b
+                    fijk_rot[f, p[0], p[1], p[2]] = (n_rot + base_rot) % 6
+    return edge_nf, edge_rot, edge_t, edge_cidx
