@@ -1,8 +1,10 @@
 from .base import IndexSystem
 from .bng import BNGIndexSystem
 from .custom import CustomIndexSystem, GridConf, custom_from_name
+from .h3 import H3IndexSystem
 
 BNG = BNGIndexSystem()
+H3 = H3IndexSystem()
 
 
 def index_system_from_name(name: str) -> IndexSystem:
@@ -11,9 +13,7 @@ def index_system_from_name(name: str) -> IndexSystem:
     if up == "BNG":
         return BNG
     if up == "H3":
-        from .h3 import H3IndexSystem
-
-        return H3IndexSystem()
+        return H3
     if up.startswith("CUSTOM"):
         return custom_from_name(name)
     raise ValueError(f"unknown index system {name!r}")
@@ -21,7 +21,9 @@ def index_system_from_name(name: str) -> IndexSystem:
 
 __all__ = [
     "BNG",
+    "H3",
     "BNGIndexSystem",
+    "H3IndexSystem",
     "CustomIndexSystem",
     "GridConf",
     "IndexSystem",

@@ -1,0 +1,518 @@
+"""Tessellation engine: decompose geometries into grid-cell chips.
+
+Reference analog: `core/Mosaic.scala` — `getChips` dispatches by geometry
+type (`:21-35`), polygons go through `mosaicFill`'s buffer-and-carve
+(`:60-87`: erode by the index buffer radius to find core cells, buffer the
+boundary to find border cells, then intersect each border cell with the
+geometry via JTS), lines through a BFS walk (`:146-194`), points to a single
+cell (`:47-58`). Chips carry (is_core, cell_id, geometry)
+(`core/types/model/MosaicChip.scala:20-76`).
+
+The TPU-native redesign drops the buffer-and-carve heuristic for an *exact*
+vectorized classification over candidate-cell batches:
+
+    core    — every cell-boundary vertex inside the geometry, AND no
+              geometry edge crosses a cell edge, AND no geometry vertex
+              strictly inside the cell  ⇒  the whole (convex) cell is inside.
+    outside — no contact at all (same three tests all empty, and the cell
+              center outside).
+    border  — everything else; chip geometry = geometry ∩ cell, computed by
+              Sutherland–Hodgman clipping of each ring against the convex
+              cell window (cells are squares or near-convex H3 hexagons —
+              no general boolean op needed on the hot path).
+
+This is stricter than the reference's contract: *every* core chip is provably
+covered by its geometry (the reference's eroded-polyfill can only approximate
+this; cf. `IndexSystem.getCoreChips` `core/index/IndexSystem.scala:181-186`).
+Chip area is conserved: sum(core cell areas) + sum(border clip areas) equals
+the geometry area — a property the tests assert.
+
+All classification math is vectorized float64 numpy on host; the
+device-resident analog for huge columns rides the same predicates through
+`mosaic_tpu.kernels`. Clipping of concave rings may emit zero-width bridge
+edges (standard Sutherland–Hodgman behavior); areas and point-in-polygon
+parity are unaffected.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .index.base import IndexSystem
+from .types import GeometryBuilder, GeometryType, PackedGeometry, ring_signed_area
+
+_EPS = 1e-12
+
+
+# --------------------------------------------------------------------------
+# chip table
+# --------------------------------------------------------------------------
+@dataclasses.dataclass
+class ChipTable:
+    """Exploded chip rows (reference: the rows `MosaicExplode` generates).
+
+    geom_id[i] is the row index of the source geometry in the input column;
+    chips holds one geometry per row (cell polygon for core chips when
+    ``keep_core_geoms``, clipped intersection for border chips, clipped
+    polyline/point for line/point chips). ``has_geom`` marks rows whose chip
+    geometry was materialized (core chips with ``keep_core_geoms=False``
+    store a placeholder empty polygon, like the reference's null geometry).
+    """
+
+    geom_id: np.ndarray  # (C,) int64
+    cell_id: np.ndarray  # (C,) int64
+    is_core: np.ndarray  # (C,) bool
+    chips: PackedGeometry
+    has_geom: np.ndarray  # (C,) bool
+
+    def __len__(self) -> int:
+        return int(self.geom_id.shape[0])
+
+    def core_count(self) -> int:
+        return int(self.is_core.sum())
+
+
+# --------------------------------------------------------------------------
+# host geometry helpers (float64 exact-ish path)
+# --------------------------------------------------------------------------
+def _geom_rings(col: PackedGeometry, g: int) -> list[tuple[np.ndarray, bool, int]]:
+    """[(ring_xy, is_hole, part_index)] for geometry g (open rings)."""
+    out = []
+    for p in col.geom_parts(g):
+        for k, r in enumerate(col.part_rings(p)):
+            out.append((col.ring_xy(r), k > 0, p))
+    return out
+
+
+def _even_odd_inside(pts: np.ndarray, rings: list[np.ndarray]) -> np.ndarray:
+    """(M,) bool — even-odd crossing test of pts against a set of rings."""
+    M = pts.shape[0]
+    cnt = np.zeros(M, dtype=np.int64)
+    px, py = pts[:, 0][:, None], pts[:, 1][:, None]
+    for ring in rings:
+        if ring.shape[0] < 3:
+            continue
+        a = ring
+        b = np.roll(ring, -1, axis=0)
+        ay, by = a[None, :, 1], b[None, :, 1]
+        ax, bx = a[None, :, 0], b[None, :, 0]
+        straddle = (ay > py) != (by > py)
+        denom = by - ay
+        denom = np.where(denom == 0, 1.0, denom)
+        xc = ax + (py - ay) * (bx - ax) / denom
+        cnt += np.sum(straddle & (px < xc), axis=1)
+    return (cnt & 1) == 1
+
+
+def _segments_cross(a0, a1, b0, b1) -> np.ndarray:
+    """Pairwise segment intersection (incl. touching): a* (E,2), b* (F,2) ->
+    (E, F) bool."""
+
+    def cross(o, d, p):
+        # cross(d, p - o) for all pairs: o,d (E,2) vs p (F,2) -> (E,F)
+        return d[:, None, 0] * (p[None, :, 1] - o[:, None, 1]) - d[:, None, 1] * (
+            p[None, :, 0] - o[:, None, 0]
+        )
+
+    da = a1 - a0  # (E,2)
+    db = b1 - b0  # (F,2)
+    d1 = cross(a0, da, b0)  # orient of b0 wrt a
+    d2 = cross(a0, da, b1)
+    d3 = cross(b0, db, a0).T  # (E,F): orient of a0 wrt b
+    d4 = cross(b0, db, a1).T
+    proper = ((d1 > _EPS) != (d2 > _EPS)) & ((d3 > _EPS) != (d4 > _EPS)) & (
+        (d1 < -_EPS) != (d2 < -_EPS)
+    ) & ((d3 < -_EPS) != (d4 < -_EPS))
+
+    def on_seg(o, d, p, c):
+        # collinear (|c| <= eps) and p within o..o+d bbox
+        lo = np.minimum(o, o + d)
+        hi = np.maximum(o, o + d)
+        inside = (
+            (p[None, :, 0] >= lo[:, None, 0] - _EPS)
+            & (p[None, :, 0] <= hi[:, None, 0] + _EPS)
+            & (p[None, :, 1] >= lo[:, None, 1] - _EPS)
+            & (p[None, :, 1] <= hi[:, None, 1] + _EPS)
+        )
+        return (np.abs(c) <= _EPS) & inside
+
+    touch = (
+        on_seg(a0, da, b0, d1)
+        | on_seg(a0, da, b1, d2)
+        | on_seg(b0, db, a0, d3.T).T
+        | on_seg(b0, db, a1, d4.T).T
+    )
+    return proper | touch
+
+
+def _in_convex(pts: np.ndarray, cell: np.ndarray) -> np.ndarray:
+    """(M,) bool — pts strictly inside convex CCW polygon ``cell`` (k,2)."""
+    a = cell
+    b = np.roll(cell, -1, axis=0)
+    d = b - a  # (k,2)
+    s = d[None, :, 0] * (pts[:, None, 1] - a[None, :, 1]) - d[None, :, 1] * (
+        pts[:, None, 0] - a[None, :, 0]
+    )
+    return np.all(s > _EPS, axis=1)
+
+
+def _dedupe_boundary(bnd: np.ndarray) -> np.ndarray:
+    """Strip repeated padding vertices from one cell boundary (B,2)->(k,2),
+    oriented CCW."""
+    keep = [0]
+    for i in range(1, bnd.shape[0]):
+        if not np.allclose(bnd[i], bnd[keep[-1]], atol=1e-14):
+            keep.append(i)
+    while len(keep) > 1 and np.allclose(bnd[keep[-1]], bnd[keep[0]], atol=1e-14):
+        keep.pop()
+    cell = bnd[keep]
+    if cell.shape[0] >= 3 and ring_signed_area(cell) < 0:
+        cell = cell[::-1]
+    return cell
+
+
+def clip_ring_convex(ring: np.ndarray, cell: np.ndarray) -> np.ndarray:
+    """Sutherland–Hodgman: clip ``ring`` (n,2, open) to convex CCW ``cell``.
+
+    Returns the clipped ring (m, 2), possibly empty. Output is open-form.
+    """
+    out = ring
+    a = cell
+    b = np.roll(cell, -1, axis=0)
+    for i in range(cell.shape[0]):
+        if out.shape[0] == 0:
+            break
+        ax, ay = a[i]
+        dx, dy = b[i, 0] - ax, b[i, 1] - ay
+        cur = out
+        nxt = np.roll(cur, -1, axis=0)
+        s_cur = dx * (cur[:, 1] - ay) - dy * (cur[:, 0] - ax)
+        s_nxt = dx * (nxt[:, 1] - ay) - dy * (nxt[:, 0] - ax)
+        pieces = []
+        inside_cur = s_cur >= -_EPS
+        inside_nxt = s_nxt >= -_EPS
+        denom = s_cur - s_nxt
+        denom = np.where(np.abs(denom) < _EPS, 1.0, denom)
+        t = s_cur / denom
+        inter = cur + np.clip(t, 0.0, 1.0)[:, None] * (nxt - cur)
+        for j in range(cur.shape[0]):
+            if inside_cur[j]:
+                pieces.append(cur[j])
+                if not inside_nxt[j]:
+                    pieces.append(inter[j])
+            elif inside_nxt[j]:
+                pieces.append(inter[j])
+        out = np.asarray(pieces).reshape(-1, 2)
+        if out.shape[0]:
+            # drop consecutive duplicates introduced at corners
+            d = np.linalg.norm(out - np.roll(out, 1, axis=0), axis=1)
+            out = out[d > 1e-13] if np.any(d > 1e-13) else out[:1]
+    return out if out.shape[0] >= 3 else np.zeros((0, 2))
+
+
+def clip_segments_convex(
+    pts: np.ndarray, cell: np.ndarray
+) -> list[np.ndarray]:
+    """Clip an open polyline (n,2) to a convex CCW cell; returns the list of
+    clipped sub-polylines (each (m>=2, 2)). Cyrus–Beck per segment, merged."""
+    a = cell
+    b = np.roll(cell, -1, axis=0)
+    nrm = np.stack([-(b[:, 1] - a[:, 1]), b[:, 0] - a[:, 0]], axis=1)  # inward
+    runs: list[np.ndarray] = []
+    cur: list[np.ndarray] = []
+    for i in range(pts.shape[0] - 1):
+        p, q = pts[i], pts[i + 1]
+        d = q - p
+        t0, t1 = 0.0, 1.0
+        ok = True
+        for e in range(cell.shape[0]):
+            den = float(np.dot(nrm[e], d))
+            num = float(np.dot(nrm[e], a[e] - p))
+            if abs(den) < _EPS:
+                if num > _EPS:  # parallel & outside
+                    ok = False
+                    break
+                continue
+            t = num / den
+            if den > 0:
+                t0 = max(t0, t)
+            else:
+                t1 = min(t1, t)
+            if t0 > t1 + _EPS:
+                ok = False
+                break
+        if not ok:
+            if len(cur) >= 2:
+                runs.append(np.asarray(cur))
+            cur = []
+            continue
+        c0 = p + max(t0, 0.0) * d
+        c1 = p + min(t1, 1.0) * d
+        if np.linalg.norm(c1 - c0) <= _EPS:
+            continue
+        if cur and np.allclose(cur[-1], c0, atol=1e-12):
+            cur.append(c1)
+        else:
+            if len(cur) >= 2:
+                runs.append(np.asarray(cur))
+            cur = [c0, c1]
+    if len(cur) >= 2:
+        runs.append(np.asarray(cur))
+    return runs
+
+
+# --------------------------------------------------------------------------
+# per-geometry-type chip generation
+# --------------------------------------------------------------------------
+def _classify_cells(
+    rings: list[tuple[np.ndarray, bool, int]],
+    cells_xy: list[np.ndarray],
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized core/border/outside classification for polygon rings.
+
+    Returns (is_core (K,), is_border (K,)) over the candidate cells.
+    """
+    K = len(cells_xy)
+    ring_arrays = [r for r, _, _ in rings]
+    gverts = np.concatenate(ring_arrays) if ring_arrays else np.zeros((0, 2))
+    # geometry edge list
+    ea, eb = [], []
+    for r in ring_arrays:
+        if r.shape[0] >= 2:
+            ea.append(r)
+            eb.append(np.roll(r, -1, axis=0))
+    ga = np.concatenate(ea) if ea else np.zeros((0, 2))
+    gb = np.concatenate(eb) if eb else np.zeros((0, 2))
+
+    is_core = np.zeros(K, dtype=bool)
+    is_border = np.zeros(K, dtype=bool)
+    # corner-in-geometry for all cells at once
+    all_corners = np.concatenate(cells_xy) if K else np.zeros((0, 2))
+    corner_off = np.cumsum([0] + [c.shape[0] for c in cells_xy])
+    corners_in = _even_odd_inside(all_corners, ring_arrays)
+    centers = np.asarray([c.mean(axis=0) for c in cells_xy]).reshape(-1, 2)
+    centers_in = _even_odd_inside(centers, ring_arrays)
+    for k, cell in enumerate(cells_xy):
+        cin = corners_in[corner_off[k] : corner_off[k + 1]]
+        # any geometry vertex strictly inside this cell?
+        vin = bool(np.any(_in_convex(gverts, cell))) if gverts.shape[0] else False
+        # any geometry edge touching any cell edge?
+        ca = cell
+        cb = np.roll(cell, -1, axis=0)
+        crossing = (
+            bool(np.any(_segments_cross(ga, gb, ca, cb))) if ga.shape[0] else False
+        )
+        if np.all(cin) and not crossing and not vin:
+            is_core[k] = True
+        elif np.any(cin) or crossing or vin or bool(centers_in[k]):
+            is_border[k] = True
+    return is_core, is_border
+
+
+def _polygon_chips(
+    col: PackedGeometry,
+    g: int,
+    index: IndexSystem,
+    resolution: int,
+    keep_core_geoms: bool,
+    out_geom_id: list,
+    out_cell: list,
+    out_core: list,
+    out_hasgeom: list,
+    builder: GeometryBuilder,
+) -> None:
+    rings = _geom_rings(col, g)
+    bounds = col.bounds()[g]
+    cand = np.asarray(index.polyfill_candidates(bounds, resolution))
+    if cand.size == 0:
+        return
+    bnds = np.asarray(index.cell_boundary(cand), dtype=np.float64)
+    cells_xy = [_dedupe_boundary(bnds[i]) for i in range(len(cand))]
+    ok = np.asarray([c.shape[0] >= 3 for c in cells_xy])
+    cand, cells_xy = cand[ok], [c for c, o in zip(cells_xy, ok) if o]
+    is_core, is_border = _classify_cells(rings, cells_xy)
+    srid = int(col.srid[g])
+    for k in range(len(cand)):
+        if is_core[k]:
+            out_geom_id.append(g)
+            out_cell.append(int(cand[k]))
+            out_core.append(True)
+            out_hasgeom.append(keep_core_geoms)
+            if keep_core_geoms:
+                builder.add_geometry(GeometryType.POLYGON, [[cells_xy[k]]], srid)
+            else:
+                builder.add_geometry(GeometryType.POLYGON, [[np.zeros((0, 2))]], srid)
+        elif is_border[k]:
+            # clip every part separately; keep nonempty shells with their holes
+            parts_out = []
+            cur_part = None
+            cur_rings: list[np.ndarray] = []
+            for ring, is_hole, part in rings:
+                if part != cur_part:
+                    if cur_rings:
+                        parts_out.append(cur_rings)
+                    cur_part, cur_rings = part, []
+                clipped = clip_ring_convex(ring, cells_xy[k])
+                if clipped.shape[0] >= 3:
+                    if not is_hole or cur_rings:
+                        cur_rings.append(clipped)
+                    # hole with no surviving shell: cell inside hole — but
+                    # then it would not be border; skip defensively
+            if cur_rings:
+                parts_out.append(cur_rings)
+            if not parts_out:
+                continue  # grazing contact only — no area in this cell
+            out_geom_id.append(g)
+            out_cell.append(int(cand[k]))
+            out_core.append(False)
+            out_hasgeom.append(True)
+            if len(parts_out) == 1:
+                builder.add_geometry(GeometryType.POLYGON, [parts_out[0]], srid)
+            else:
+                builder.add_geometry(GeometryType.MULTIPOLYGON, parts_out, srid)
+
+
+def _line_chips(
+    col: PackedGeometry,
+    g: int,
+    index: IndexSystem,
+    resolution: int,
+    out_geom_id: list,
+    out_cell: list,
+    out_core: list,
+    out_hasgeom: list,
+    builder: GeometryBuilder,
+) -> None:
+    """Reference analog: BFS `lineDecompose` (`core/Mosaic.scala:146-194`) —
+    here: candidate cells over the bbox, clip the line to each, keep cells
+    with nonempty clip. Line chips are never core."""
+    bounds = col.bounds()[g]
+    cand = np.asarray(index.polyfill_candidates(bounds, resolution))
+    if cand.size == 0:
+        return
+    bnds = np.asarray(index.cell_boundary(cand), dtype=np.float64)
+    srid = int(col.srid[g])
+    parts = [col.ring_xy(r) for p in col.geom_parts(g) for r in col.part_rings(p)]
+    for k in range(len(cand)):
+        cell = _dedupe_boundary(bnds[k])
+        if cell.shape[0] < 3:
+            continue
+        runs: list[np.ndarray] = []
+        for pts in parts:
+            runs.extend(clip_segments_convex(pts, cell))
+        if not runs:
+            continue
+        out_geom_id.append(g)
+        out_cell.append(int(cand[k]))
+        out_core.append(False)
+        out_hasgeom.append(True)
+        if len(runs) == 1:
+            builder.add_geometry(GeometryType.LINESTRING, [[runs[0]]], srid)
+        else:
+            builder.add_geometry(
+                GeometryType.MULTILINESTRING, [[r] for r in runs], srid
+            )
+
+
+def _point_chips(
+    col: PackedGeometry,
+    g: int,
+    index: IndexSystem,
+    resolution: int,
+    out_geom_id: list,
+    out_cell: list,
+    out_core: list,
+    out_hasgeom: list,
+    builder: GeometryBuilder,
+) -> None:
+    """Reference analog: `Mosaic.pointChip` (`core/Mosaic.scala:47-58`) —
+    one non-core chip per point carrying the point geometry."""
+    srid = int(col.srid[g])
+    pts = col.geom_xy(g)
+    cells = np.asarray(index.point_to_cell(pts, resolution)).reshape(-1)
+    for i in range(pts.shape[0]):
+        out_geom_id.append(g)
+        out_cell.append(int(cells[i]))
+        out_core.append(False)
+        out_hasgeom.append(True)
+        builder.add_geometry(GeometryType.POINT, [[pts[i : i + 1]]], srid)
+
+
+def tessellate(
+    col: PackedGeometry,
+    index: IndexSystem,
+    resolution: int,
+    keep_core_geoms: bool = True,
+) -> ChipTable:
+    """Decompose every geometry in ``col`` into grid chips.
+
+    Reference analog: `grid_tessellateexplode` / `MosaicExplode.eval`
+    (`expressions/index/MosaicExplode.scala:70-79`) — but batch-first: one
+    call chips a whole column.
+    """
+    resolution = index.resolution_arg(resolution)
+    geom_id: list[int] = []
+    cell: list[int] = []
+    core: list[bool] = []
+    hasgeom: list[bool] = []
+    builder = GeometryBuilder()
+    for g in range(len(col)):
+        base = col.geometry_type(g).base
+        args = (col, g, index, resolution)
+        if base == GeometryType.POLYGON:
+            _polygon_chips(
+                *args, keep_core_geoms, geom_id, cell, core, hasgeom, builder
+            )
+        elif base == GeometryType.LINESTRING:
+            _line_chips(*args, geom_id, cell, core, hasgeom, builder)
+        elif base == GeometryType.POINT:
+            _point_chips(*args, geom_id, cell, core, hasgeom, builder)
+        else:
+            raise ValueError(f"cannot tessellate geometry type {base}")
+    return ChipTable(
+        geom_id=np.asarray(geom_id, dtype=np.int64),
+        cell_id=np.asarray(cell, dtype=np.int64),
+        is_core=np.asarray(core, dtype=bool),
+        chips=builder.build(),
+        has_geom=np.asarray(hasgeom, dtype=bool),
+    )
+
+
+def polyfill(
+    col: PackedGeometry, index: IndexSystem, resolution: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Centroid-rule polyfill: cells whose center lies inside each geometry.
+
+    Reference analog: `Polyfill` expression → H3 JNI polyfill
+    (`core/index/H3IndexSystem.scala:113-126`; centroid semantics) and BNG's
+    centroid BFS (`core/index/BNGIndexSystem.scala:180-204`).
+
+    Returns CSR ``(cells (T,), offsets (G+1,))``.
+    """
+    resolution = index.resolution_arg(resolution)
+    all_cells: list[np.ndarray] = []
+    offsets = [0]
+    bounds = col.bounds()
+    for g in range(len(col)):
+        base = col.geometry_type(g).base
+        if base != GeometryType.POLYGON:
+            offsets.append(offsets[-1])
+            all_cells.append(np.zeros(0, np.int64))
+            continue
+        cand = np.asarray(index.polyfill_candidates(bounds[g], resolution))
+        if cand.size == 0:
+            offsets.append(offsets[-1])
+            all_cells.append(np.zeros(0, np.int64))
+            continue
+        centers = np.asarray(index.cell_center(cand), dtype=np.float64)
+        rings = [r for r, _, _ in _geom_rings(col, g)]
+        inside = _even_odd_inside(centers, rings)
+        kept = np.unique(cand[inside])
+        all_cells.append(kept)
+        offsets.append(offsets[-1] + kept.size)
+    return (
+        np.concatenate(all_cells) if all_cells else np.zeros(0, np.int64),
+        np.asarray(offsets, dtype=np.int64),
+    )

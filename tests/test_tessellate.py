@@ -1,0 +1,174 @@
+"""Tessellation engine tests.
+
+Mirrors the reference's MosaicExplode/MosaicFill behavior suites
+(`expressions/index/MosaicExplodeBehaviors.scala`) with exact invariants:
+area conservation, core-chip containment, centroid-rule polyfill, line
+length conservation — across the index-system matrix (SURVEY.md §4).
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core import tessellate as tz
+from mosaic_tpu.core.geometry import oracle, wkt
+from mosaic_tpu.core.index import BNG, H3, CustomIndexSystem, GridConf
+
+CUSTOM = CustomIndexSystem(
+    GridConf(-180, 180, -90, 90, 2, 10.0, 10.0)
+)  # reference test grid: CustomIndexSystem(GridConf(-180,180,-90,90,2,360,180))
+
+POLY = "POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1))"
+POLY_HOLE = "POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1), (5 5, 5 8, 8 8, 8 5, 5 5))"
+MULTIPOLY = "MULTIPOLYGON (((0 0, 6 0, 6 6, 0 6, 0 0)), ((20 20, 26 20, 26 27, 20 27, 20 20)))"
+LINE = "LINESTRING (0 0, 9 4, 14 -3, 21 8)"
+
+
+def chip_areas(table: tz.ChipTable) -> np.ndarray:
+    return oracle.area(table.chips)
+
+
+class TestPolygonTessellation:
+    @pytest.mark.parametrize("res", [2, 3])
+    @pytest.mark.parametrize("w", [POLY, POLY_HOLE, MULTIPOLY])
+    def test_area_conserved_custom(self, w, res):
+        col = wkt.from_wkt([w])
+        table = tz.tessellate(col, CUSTOM, res)
+        assert len(table) > 0
+        total = chip_areas(table).sum()
+        np.testing.assert_allclose(total, oracle.area(col)[0], rtol=1e-9)
+
+    def test_core_and_border_present(self):
+        col = wkt.from_wkt([POLY])
+        table = tz.tessellate(col, CUSTOM, 3)
+        assert table.core_count() > 0
+        assert (~table.is_core).sum() > 0
+        # no duplicate cells per geometry
+        assert len(np.unique(table.cell_id)) == len(table)
+
+    def test_core_chips_fully_inside(self):
+        col = wkt.from_wkt([POLY_HOLE])
+        table = tz.tessellate(col, CUSTOM, 3)
+        rng = np.random.default_rng(1)
+        bb = table.chips.bounds()
+        for i in np.nonzero(table.is_core)[0]:
+            pts = np.column_stack(
+                [
+                    rng.uniform(bb[i, 0], bb[i, 2], 64),
+                    rng.uniform(bb[i, 1], bb[i, 3], 64),
+                ]
+            )
+            inside = oracle.contains_points(col, 0, pts)
+            assert inside.all(), f"core chip {i} leaks outside the polygon"
+
+    def test_border_chips_subset_of_cell_and_geom(self):
+        col = wkt.from_wkt([POLY])
+        table = tz.tessellate(col, CUSTOM, 3)
+        border = np.nonzero(~table.is_core)[0]
+        assert border.size
+        for i in border[:8]:
+            # border chip area strictly less than the cell area
+            cell_area = CUSTOM.cell_area_approx(3)
+            assert chip_areas(table)[i] < cell_area + 1e-9
+
+    def test_keep_core_geoms_false(self):
+        col = wkt.from_wkt([POLY])
+        t1 = tz.tessellate(col, CUSTOM, 3, keep_core_geoms=False)
+        assert not t1.has_geom[t1.is_core].any()
+        assert t1.has_geom[~t1.is_core].all()
+
+    def test_hole_respected(self):
+        col = wkt.from_wkt([POLY_HOLE])
+        table = tz.tessellate(col, CUSTOM, 4)
+        # a cell entirely inside the hole must not appear
+        centers = np.asarray(CUSTOM.cell_center(table.cell_id))
+        hole_interior = (
+            (centers[:, 0] > 5.6)
+            & (centers[:, 0] < 7.4)
+            & (centers[:, 1] > 5.6)
+            & (centers[:, 1] < 7.4)
+            & table.is_core
+        )
+        assert not hole_interior.any()
+
+    def test_multi_geometry_ids(self):
+        col = wkt.from_wkt([POLY, MULTIPOLY])
+        table = tz.tessellate(col, CUSTOM, 3)
+        assert set(np.unique(table.geom_id)) == {0, 1}
+        a = chip_areas(table)
+        np.testing.assert_allclose(
+            [a[table.geom_id == 0].sum(), a[table.geom_id == 1].sum()],
+            oracle.area(col),
+            rtol=1e-9,
+        )
+
+
+class TestPolygonH3BNG:
+    def test_area_conserved_h3(self):
+        w = "POLYGON ((-73.98 40.75, -73.94 40.75, -73.94 40.78, -73.98 40.78, -73.98 40.75))"
+        col = wkt.from_wkt([w])
+        table = tz.tessellate(col, H3, 9)
+        assert table.core_count() > 0
+        total = chip_areas(table).sum()
+        # H3 hexagons in lat/lng are near- but not exactly convex: loose tol
+        np.testing.assert_allclose(total, oracle.area(col)[0], rtol=1e-3)
+
+    def test_area_conserved_bng(self):
+        w = "POLYGON ((216000 771000, 219500 771400, 219000 774800, 216200 774000, 216000 771000))"
+        col = wkt.from_wkt([w], srid=27700)
+        table = tz.tessellate(col, BNG, 4)
+        assert table.core_count() > 0
+        np.testing.assert_allclose(
+            chip_areas(table).sum(), oracle.area(col)[0], rtol=1e-9
+        )
+
+
+class TestLinePointChips:
+    def test_line_length_conserved(self):
+        col = wkt.from_wkt([LINE])
+        table = tz.tessellate(col, CUSTOM, 3)
+        assert not table.is_core.any()
+        np.testing.assert_allclose(
+            oracle.length(table.chips).sum(), oracle.length(col)[0], rtol=1e-9
+        )
+
+    def test_multiline(self):
+        col = wkt.from_wkt(["MULTILINESTRING ((0 0, 9 4), (11 11, 14 -3))"])
+        table = tz.tessellate(col, CUSTOM, 3)
+        np.testing.assert_allclose(
+            oracle.length(table.chips).sum(), oracle.length(col)[0], rtol=1e-9
+        )
+
+    def test_point_chip(self):
+        col = wkt.from_wkt(["POINT (3 4)", "MULTIPOINT ((1 1), (15 15))"])
+        table = tz.tessellate(col, CUSTOM, 3)
+        assert len(table) == 3
+        expected = np.asarray(
+            CUSTOM.point_to_cell(np.array([[3.0, 4], [1, 1], [15, 15]]), 3)
+        )
+        np.testing.assert_array_equal(np.sort(table.cell_id), np.sort(expected))
+        assert not table.is_core.any()
+
+
+class TestPolyfill:
+    @pytest.mark.parametrize("index,res,w", [
+        (CUSTOM, 3, POLY),
+        (CUSTOM, 4, POLY_HOLE),
+        (H3, 8, "POLYGON ((-73.98 40.75, -73.94 40.75, -73.94 40.78, -73.98 40.78, -73.98 40.75))"),
+    ])
+    def test_centroid_rule(self, index, res, w):
+        col = wkt.from_wkt([w])
+        cells, offs = tz.polyfill(col, index, res)
+        assert offs[-1] == cells.size and cells.size > 0
+        centers = np.asarray(index.cell_center(cells), dtype=np.float64)
+        inside = oracle.contains_points(col, 0, centers)
+        assert inside.all()
+
+    def test_polyfill_matches_tessellation_cover(self):
+        col = wkt.from_wkt([POLY])
+        cells, _ = tz.polyfill(col, CUSTOM, 3)
+        table = tz.tessellate(col, CUSTOM, 3)
+        # every polyfill cell appears in the tessellation cover
+        assert np.isin(cells, table.cell_id).all()
+        # every core cell's center is inside => core ⊆ polyfill
+        core = table.cell_id[table.is_core]
+        assert np.isin(core, cells).all()
