@@ -115,3 +115,27 @@ def contains_points(col: PackedGeometry, g: int, pts: np.ndarray) -> np.ndarray:
 
 def bounds(col: PackedGeometry) -> np.ndarray:
     return col.bounds()
+
+
+def point_boundary_distance(col: PackedGeometry, g: int, pt: np.ndarray) -> float:
+    """Min distance from pt to any boundary edge of geometry g (f64 host)."""
+    from ..types import GeometryType
+
+    p = np.asarray(pt, dtype=np.float64)
+    closed = col.geometry_type(g).base == GeometryType.POLYGON
+    best = np.inf
+    for _, xy in _rings(col, g):
+        if xy.shape[0] == 0:
+            continue
+        if xy.shape[0] == 1:
+            best = min(best, float(np.linalg.norm(xy[0] - p)))
+            continue
+        a = xy if closed else xy[:-1]
+        b = np.roll(xy, -1, axis=0) if closed else xy[1:]
+        d = b - a
+        l2 = np.sum(d * d, axis=1)
+        l2 = np.where(l2 == 0, 1.0, l2)
+        t = np.clip(np.sum((p - a) * d, axis=1) / l2, 0.0, 1.0)
+        proj = a + t[:, None] * d
+        best = min(best, float(np.min(np.linalg.norm(proj - p, axis=1))))
+    return best

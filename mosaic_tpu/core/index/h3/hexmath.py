@@ -70,34 +70,25 @@ def ijk_to_hex2d(i, j, k, xp=np):
 
 def hex2d_to_ijk(x, y, xp=np):
     """Nearest hex center (cube-coordinate rounding). Returns normalized
-    non-negative (i, j, k) int64."""
-    jj = y / C.SIN60
-    ii = x + 0.5 * jj
-    # cube coords (q, r, s) = (ii, jj, -ii-jj)
-    q, r, s = ii, jj, -ii - jj
-    rq = xp.round(q)
-    rr = xp.round(r)
-    rs = xp.round(s)
-    dq = xp.abs(rq - q)
-    dr = xp.abs(rr - r)
-    ds = xp.abs(rs - s)
-    # fix the coordinate with the largest rounding error
-    fix_q = (dq > dr) & (dq > ds)
-    fix_r = ~fix_q & (dr > ds)
-    rq = xp.where(fix_q, -rr - rs, rq)
-    rr = xp.where(fix_r, -rq - rs, rr)
-    i = rq.astype(np.int64 if xp is np else xp.int64)
-    j = rr.astype(np.int64 if xp is np else xp.int64)
-    k = xp.zeros_like(i)
-    return ijk_normalize(i, j, k, xp)
+    non-negative (i, j, k) int64.
+
+    Basis care: in this lattice (x = ii - jj/2, y = jj·sin60) the six unit
+    neighbors are (±1,0), (0,±1), ±(1,1) — so the cube embedding with
+    neighbor-distance 1 is (q, r, s) = (ii, -jj, jj - ii), NOT the textbook
+    (ii, jj, -ii-jj) (whose neighbor set contains (1,-1), which is NOT a
+    lattice neighbor here — rounding in that basis misassigns ~1/6 of the
+    plane)."""
+    ii, jj = hex2d_to_axial(x, y, xp)
+    return ijk_normalize(ii, jj, xp.zeros_like(ii), xp)
 
 
 def hex2d_to_axial(x, y, xp=np):
-    """Nearest hex center in *unnormalized* axial coords (q, r) — needed for
-    grid distance where the k=0 plane offset matters."""
+    """Nearest hex center in *unnormalized* axial coords (ii, jj) — needed
+    for grid distance where the k=0 plane offset matters. Same cube basis
+    correction as :func:`hex2d_to_ijk`."""
     jj = y / C.SIN60
     ii = x + 0.5 * jj
-    q, r, s = ii, jj, -ii - jj
+    q, r, s = ii, -jj, jj - ii
     rq = xp.round(q)
     rr = xp.round(r)
     rs = xp.round(s)
@@ -108,7 +99,7 @@ def hex2d_to_axial(x, y, xp=np):
     fix_r = ~fix_q & (dr > ds)
     rq = xp.where(fix_q, -rr - rs, rq)
     rr = xp.where(fix_r, -rq - rs, rr)
-    return rq.astype(np.int64), rr.astype(np.int64)
+    return rq.astype(np.int64), (-rr).astype(np.int64)
 
 
 def up_ap7(i, j, k, xp=np):

@@ -1,0 +1,5 @@
+"""High-level services (reference analog: `src/main/scala/.../sql/`)."""
+
+from .join import ChipIndex, build_chip_index, pip_join, pip_join_points
+
+__all__ = ["ChipIndex", "build_chip_index", "pip_join", "pip_join_points"]

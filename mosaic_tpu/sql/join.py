@@ -1,0 +1,182 @@
+"""Index-assisted point-in-polygon join — the north-star workload.
+
+Reference analog: `sql/join/PointInPolygonJoin.scala:15-98` and the
+Quickstart benchmark (`notebooks/examples/scala/QuickstartNotebook.scala:
+204-216`): points get a cell id, polygons are tessellated into chips, the
+join is an equi-join on cell id, and the exact `st_contains` predicate runs
+only on border-chip matches (`is_core || st_contains(wkb, point)`).
+
+TPU-native redesign: there is no shuffle. The chip table is compiled into a
+device-resident :class:`ChipIndex` — a sorted cell-id vector plus a dense
+``(U, M)`` slot table of chip rows — which is small enough to replicate
+(all-gather over ICI) on every chip of a mesh, while the billion-point side
+shards over devices. Per point the join is then:
+
+    searchsorted(cells, point_cell) → slot row → M candidate chips
+    hit = chip_is_core | ray_crossing(point, chip_polygon)
+
+which is one fused XLA program: no host round-trip, no dynamic shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.geometry.device import DeviceGeometry, pack_to_device
+from ..core.geometry.predicates import contains_xy_gather
+from ..core.index.base import IndexSystem
+from ..core.tessellate import ChipTable, tessellate
+from ..core.types import PackedGeometry
+
+_SENTINEL = jnp.iinfo(jnp.int32).max
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ChipIndex:
+    """Device-resident join index over a tessellated polygon table.
+
+    cells:     (U,) int64 — sorted unique cell ids present in the chip table.
+    chip_rows: (U, M) int32 — chip-row ids per cell, -1 padded (M = max
+               chips per cell, static).
+    chip_geom: (C,) int32 — source polygon row per chip.
+    chip_core: (C,) bool — core chips skip the predicate.
+    border:    DeviceGeometry over all C chip rows (core rows are empty and
+               never evaluated).
+    """
+
+    cells: jax.Array
+    chip_rows: jax.Array
+    chip_geom: jax.Array
+    chip_core: jax.Array
+    border: DeviceGeometry
+
+    @property
+    def num_cells(self) -> int:
+        return int(self.cells.shape[0])
+
+    @property
+    def max_chips_per_cell(self) -> int:
+        return int(self.chip_rows.shape[1])
+
+
+def build_chip_index(
+    table: ChipTable,
+    dtype=jnp.float32,
+    max_chips_per_cell: int | None = None,
+    recenter: bool = True,
+) -> ChipIndex:
+    """Host: compile a ChipTable into the device join index."""
+    C = len(table)
+    if C == 0:
+        raise ValueError("empty chip table")
+    order = np.argsort(table.cell_id, kind="stable")
+    sorted_cells = table.cell_id[order]
+    uniq, starts, counts = np.unique(
+        sorted_cells, return_index=True, return_counts=True
+    )
+    M = int(max_chips_per_cell or counts.max())
+    if counts.max() > M:
+        raise ValueError(
+            f"cell with {counts.max()} chips exceeds max_chips_per_cell={M}"
+        )
+    rows = np.full((uniq.size, M), -1, dtype=np.int32)
+    for i, (s, c) in enumerate(zip(starts, counts)):
+        rows[i, :c] = order[s : s + c]
+    # only border rows need vertices: blank core chip geometries before
+    # padding so V is set by the clipped border chips, not the cell polygons
+    chips = table.chips
+    if table.is_core.any() and table.has_geom[table.is_core].any():
+        # rebuild with empty geometry for core rows
+        from ..core.types import GeometryBuilder, GeometryType
+
+        b = GeometryBuilder()
+        for g in range(C):
+            if table.is_core[g]:
+                b.add_geometry(GeometryType.POLYGON, [[np.zeros((0, 2))]], 0)
+            else:
+                b.append_from(chips, g)
+        chips = b.build()
+    return ChipIndex(
+        cells=jnp.asarray(uniq, dtype=jnp.int64),
+        chip_rows=jnp.asarray(rows),
+        chip_geom=jnp.asarray(table.geom_id.astype(np.int32)),
+        chip_core=jnp.asarray(table.is_core),
+        # recenter: chips span a city/region, so subtracting the f64 midpoint
+        # before narrowing to f32 shrinks the coordinate ulp by ~1e3 (the
+        # SURVEY §7 precision strategy) — points are shifted to match in
+        # pip_join before they are narrowed.
+        border=pack_to_device(chips, dtype=dtype, recenter=recenter),
+    )
+
+
+def pip_join_points(
+    points: jax.Array, pcells: jax.Array, index: ChipIndex
+) -> jax.Array:
+    """(N,) int32 — smallest matching polygon row per point, -1 if none.
+
+    Jittable; shard the point axis over a mesh and replicate ``index``.
+    """
+    U = index.cells.shape[0]
+    u = jnp.clip(jnp.searchsorted(index.cells, pcells), 0, U - 1)
+    cell_hit = index.cells[u] == pcells  # (N,)
+    rows = index.chip_rows[u]  # (N, M)
+    valid = cell_hit[:, None] & (rows >= 0)
+    rows_safe = jnp.maximum(rows, 0)
+    core = index.chip_core[rows_safe] & valid
+    N, M = rows.shape
+    flat_idx = rows_safe.reshape(-1)
+    flat_pts = jnp.repeat(points, M, axis=0)
+    inside = contains_xy_gather(flat_pts, flat_idx, index.border).reshape(N, M)
+    hit = core | (inside & valid & ~index.chip_core[rows_safe])
+    geoms = jnp.where(hit, index.chip_geom[rows_safe], _SENTINEL)
+    best = jnp.min(geoms, axis=1)
+    return jnp.where(best == _SENTINEL, -1, best).astype(jnp.int32)
+
+
+# module-level jit so repeated pip_join calls share the compilation cache
+_JIT_JOIN = jax.jit(pip_join_points)
+
+
+def pip_join(
+    points: np.ndarray | jax.Array,
+    polygons: PackedGeometry,
+    index_system: IndexSystem,
+    resolution: int,
+    chip_index: ChipIndex | None = None,
+    batch_size: int | None = None,
+) -> np.ndarray:
+    """Managed join (reference: `PointInPolygonJoin.join` auto-indexes both
+    sides, `sql/join/PointInPolygonJoin.scala:86-97`).
+
+    Tessellates ``polygons`` (unless a prebuilt ``chip_index`` is passed),
+    assigns cells to ``points`` and returns the matched polygon row per
+    point (-1 = no polygon). ``batch_size`` chunks the point axis to bound
+    the (N·M·E) predicate intermediate.
+    """
+    resolution = index_system.resolution_arg(resolution)
+    if chip_index is None:
+        table = tessellate(polygons, index_system, resolution, keep_core_geoms=False)
+        chip_index = build_chip_index(table)
+    raw = np.asarray(points, dtype=np.float64)
+    # shift in f64 first, narrow after (keeps f32 ulp small near the data)
+    shift = np.asarray(chip_index.border.shift, dtype=np.float64)
+    dtype = chip_index.border.verts.dtype
+    step = _JIT_JOIN
+    n = raw.shape[0]
+
+    def run(chunk: np.ndarray) -> np.ndarray:
+        cells = index_system.point_to_cell(jnp.asarray(chunk), resolution)
+        shifted = jnp.asarray(chunk - shift, dtype=dtype)
+        return np.asarray(step(shifted, cells, chip_index))
+
+    if batch_size is None or n <= batch_size:
+        return run(raw)
+    out = np.empty(n, dtype=np.int32)
+    for s in range(0, n, batch_size):
+        out[s : s + batch_size] = run(raw[s : s + batch_size])
+    return out

@@ -1,0 +1,91 @@
+"""PIP join tests: chip-index join vs the dense host oracle.
+
+Reference analog: `PointInPolygonJoinTest` — a point lands in polygon P iff
+the managed join reports P (`sql/join/PointInPolygonJoin.scala:15-98`).
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry import oracle, wkt
+from mosaic_tpu.core.index import CustomIndexSystem, GridConf, H3
+from mosaic_tpu.sql.join import build_chip_index, pip_join
+from mosaic_tpu.core.tessellate import tessellate
+
+CUSTOM = CustomIndexSystem(GridConf(-180, 180, -90, 90, 2, 10.0, 10.0))
+
+# disjoint "zones" with concave shapes and a hole
+ZONES = [
+    "POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1), (5 5, 5 8, 8 8, 8 5, 5 5))",
+    "POLYGON ((20 0, 30 0, 30 10, 25 4, 20 10, 20 0))",
+    "MULTIPOLYGON (((-20 -20, -12 -20, -12 -12, -20 -12, -20 -20)), ((-8 -8, -2 -8, -2 -2, -8 -2, -8 -8)))",
+]
+
+
+def oracle_match(col, pts):
+    """Smallest polygon row containing each point, -1 if none."""
+    out = np.full(pts.shape[0], -1, dtype=np.int32)
+    for g in reversed(range(len(col))):
+        inside = oracle.contains_points(col, g, pts)
+        out[inside] = g
+    return out
+
+
+@pytest.mark.parametrize("res", [2, 3])
+def test_join_matches_oracle(res):
+    col = wkt.from_wkt(ZONES)
+    rng = np.random.default_rng(7)
+    pts = np.column_stack(
+        [rng.uniform(-25, 35, 4000), rng.uniform(-25, 20, 4000)]
+    )
+    got = pip_join(pts, col, CUSTOM, res)
+    want = oracle_match(col, pts)
+    # f32 device coords: points within ~1e-5 of any edge may legitimately
+    # classify either way — exclude the epsilon band from exact comparison
+    diff = np.nonzero(got != want)[0]
+    if diff.size:
+        for i in diff:
+            d = min(
+                float(oracle.point_boundary_distance(col, g, pts[i]))
+                for g in range(len(col))
+            )
+            assert d < 1e-4, f"point {i} misjoined at boundary distance {d}"
+
+
+def test_join_batched_equals_single():
+    col = wkt.from_wkt(ZONES)
+    rng = np.random.default_rng(3)
+    pts = np.column_stack([rng.uniform(-25, 35, 1000), rng.uniform(-25, 20, 1000)])
+    a = pip_join(pts, col, CUSTOM, 3)
+    b = pip_join(pts, col, CUSTOM, 3, batch_size=137)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_prebuilt_chip_index_reused():
+    col = wkt.from_wkt(ZONES)
+    table = tessellate(col, CUSTOM, 3, keep_core_geoms=False)
+    ci = build_chip_index(table)
+    rng = np.random.default_rng(4)
+    pts = np.column_stack([rng.uniform(0, 14, 500), rng.uniform(0, 14, 500)])
+    got = pip_join(pts, col, CUSTOM, 3, chip_index=ci)
+    want = oracle_match(col, pts)
+    ok = got == want
+    assert ok.mean() > 0.99
+
+
+def test_join_h3_nyc_box():
+    """H3 at res 8 over an NYC-scale box — core-vs-border paths both hit."""
+    zones = [
+        "POLYGON ((-74.02 40.70, -73.96 40.70, -73.96 40.76, -74.02 40.76, -74.02 40.70))",
+        "POLYGON ((-73.96 40.70, -73.90 40.70, -73.90 40.76, -73.96 40.76, -73.96 40.70))",
+    ]
+    col = wkt.from_wkt(zones)
+    rng = np.random.default_rng(5)
+    pts = np.column_stack(
+        [rng.uniform(-74.05, -73.87, 2000), rng.uniform(40.68, 40.78, 2000)]
+    )
+    got = pip_join(pts, col, H3, 8)
+    want = oracle_match(col, pts)
+    # away from shared boundary everything must agree
+    off_boundary = np.abs(pts[:, 0] - -73.96) > 1e-3
+    np.testing.assert_array_equal(got[off_boundary], want[off_boundary])
