@@ -1,0 +1,152 @@
+"""North-star benchmark: NYC-style PIP join, points/sec on one chip.
+
+Workload shape follows the reference Quickstart
+(`notebooks/examples/scala/QuickstartNotebook.scala:149-216`): ~256 polygon
+zones tiling the NYC bbox, tessellated to H3 chips; N random pickup points
+get a cell id and join against the chip index (`is_core || contains`).
+
+Prints ONE JSON line. ``vs_baseline`` is measured against a vectorized
+NumPy implementation of the identical join (searchsorted + ray crossing) —
+the stand-in for the reference's JTS codegen path on this machine, since the
+reference publishes no numbers (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+RES = 8
+N_DEVICE = 4_000_000
+N_BASE = 200_000
+BATCH = 2_000_000
+
+
+def _numpy_join(points, cells_sorted, rows, chip_geom, chip_core, verts, ring_len, pcells):
+    """Pure-NumPy oracle of pip_join_points (vectorized over points)."""
+    U = cells_sorted.shape[0]
+    u = np.clip(np.searchsorted(cells_sorted, pcells), 0, U - 1)
+    hit_cell = cells_sorted[u] == pcells
+    cand = rows[u]  # (N, M)
+    valid = hit_cell[:, None] & (cand >= 0)
+    cand_safe = np.maximum(cand, 0)
+    core = chip_core[cand_safe] & valid
+    N, M = cand.shape
+    G, R, V, _ = verts.shape
+    inside = np.zeros((N, M), dtype=bool)
+    px, py = points[:, 0], points[:, 1]
+    for m in range(M):
+        g = cand_safe[:, m]
+        need = valid[:, m] & ~chip_core[cand_safe[:, m]]
+        if not need.any():
+            continue
+        idx = np.nonzero(need)[0]
+        gg = g[idx]
+        x, y = px[idx], py[idx]
+        cnt = np.zeros(idx.shape[0], dtype=np.int64)
+        for r in range(R):
+            L = ring_len[gg, r]  # (K,)
+            for e in range(V - 1):
+                live = e < L
+                ax, ay = verts[gg, r, e, 0], verts[gg, r, e, 1]
+                bx, by = verts[gg, r, e + 1, 0], verts[gg, r, e + 1, 1]
+                cond = ((ay > y) != (by > y)) & (
+                    x < ax + (y - ay) * (bx - ax) / np.where(by != ay, by - ay, 1.0)
+                )
+                cnt += (cond & live).astype(np.int64)
+        inside[idx, m] = (cnt % 2).astype(bool)
+    hit = core | (inside & valid)
+    out = np.where(hit, chip_geom[cand_safe], np.iinfo(np.int32).max)
+    best = out.min(axis=1)
+    return np.where(best == np.iinfo(np.int32).max, -1, best)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from mosaic_tpu.core.index.h3 import H3IndexSystem
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.datasets import random_points, synthetic_zones
+    from mosaic_tpu.sql.join import build_chip_index, pip_join_points
+
+    h3 = H3IndexSystem()
+    zones = synthetic_zones(16, 16)
+    t0 = time.perf_counter()
+    table = tessellate(zones, h3, RES, keep_core_geoms=False)
+    tess_s = time.perf_counter() - t0
+    index = build_chip_index(table)
+
+    pts = random_points(N_DEVICE, seed=11)
+    shift = np.asarray(index.border.shift, dtype=np.float64)
+    dtype = index.border.verts.dtype
+
+    @jax.jit
+    def step(points_f64, chip_index):
+        cells = h3.point_to_cell(points_f64, RES)
+        shifted = (points_f64 - chip_index.border.shift).astype(dtype)
+        return pip_join_points(shifted, cells, chip_index)
+
+    # warm up compile on one batch, then time steady-state batches
+    first = jnp.asarray(pts[:BATCH])
+    step(first, index).block_until_ready()
+    t0 = time.perf_counter()
+    outs = []
+    for s in range(0, N_DEVICE, BATCH):
+        outs.append(step(jnp.asarray(pts[s : s + BATCH]), index))
+    for o in outs:
+        o.block_until_ready()
+    dev_s = time.perf_counter() - t0
+    dev_rate = N_DEVICE / dev_s
+    match = np.concatenate([np.asarray(o) for o in outs])
+
+    # NumPy baseline on a subsample of the same workload
+    sub = pts[:N_BASE]
+    pcells = np.asarray(h3.point_to_cell(jnp.asarray(sub), RES))
+    cells_sorted = np.asarray(index.cells)
+    rows = np.asarray(index.chip_rows)
+    verts = np.asarray(index.border.verts, dtype=np.float64)
+    sub_shift = (sub - shift).astype(np.float64)
+    t0 = time.perf_counter()
+    base = _numpy_join(
+        sub_shift,
+        cells_sorted,
+        rows,
+        np.asarray(index.chip_geom),
+        np.asarray(index.chip_core),
+        verts,
+        np.asarray(index.border.ring_len),
+        pcells,
+    )
+    base_s = time.perf_counter() - t0
+    base_rate = N_BASE / base_s
+    agree = float((base == match[:N_BASE]).mean())
+
+    print(
+        json.dumps(
+            {
+                "metric": "nyc_pip_join_throughput",
+                "value": round(dev_rate, 1),
+                "unit": "points/sec/chip",
+                "vs_baseline": round(dev_rate / base_rate, 2),
+                "detail": {
+                    "n_points": N_DEVICE,
+                    "n_zones": len(zones),
+                    "n_chips": len(table),
+                    "h3_res": RES,
+                    "device": str(jax.devices()[0]),
+                    "device_s": round(dev_s, 3),
+                    "numpy_points_per_sec": round(base_rate, 1),
+                    "numpy_agreement": agree,
+                    "tessellate_s": round(tess_s, 2),
+                    "match_rate": round(float((match >= 0).mean()), 4),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,180 @@
+"""Mesh-sharded point-in-polygon join with an ICI all-gathered chip index.
+
+Reference analog: the Quickstart PIP join distributes as a Spark hash shuffle
+on cell id plus an implicit broadcast of the small polygon side
+(`sql/join/PointInPolygonJoin.scala:78-84`, SURVEY.md §2.8). The TPU-native
+redesign keeps data resident:
+
+- the **point side** (billions of rows) is sharded over *every* device of the
+  mesh and never moves;
+- the **chip index** (ChipTable compiled by `sql.join.build_chip_index`) is
+  sharded over the ``cell`` mesh axis in HBM and **all-gathered over ICI**
+  inside the jitted step, so each device materializes the full index exactly
+  when it is needed (the BASELINE.json north-star layout);
+- per-zone aggregates (the Quickstart's group-by count) are `psum`-reduced
+  across the whole mesh.
+
+No shuffle, no host round-trip: one `shard_map`-ped XLA program per step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.geometry.device import DeviceGeometry
+from ..sql.join import ChipIndex, pip_join_points
+
+_I64_MAX = np.iinfo(np.int64).max
+
+
+def make_mesh(
+    n_devices: int | None = None, devices=None, cell_axis: int | None = None
+) -> Mesh:
+    """A 2-D ``(dp, cell)`` mesh over the first ``n_devices`` devices.
+
+    ``dp`` × ``cell`` both shard the point axis; ``cell`` additionally shards
+    the chip index (which is all-gathered over that axis inside the step).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices but only {len(devs)} available"
+            )
+        devs = devs[:n_devices]
+    n = len(devs)
+    if cell_axis is None:
+        cell_axis = 2 if n % 2 == 0 and n > 1 else 1
+    if n % cell_axis:
+        raise ValueError(f"{n} devices not divisible by cell_axis={cell_axis}")
+    return Mesh(np.asarray(devs).reshape(n // cell_axis, cell_axis), ("dp", "cell"))
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def pad_index_for_shards(index: ChipIndex, shards: int) -> ChipIndex:
+    """Pad the U (cells) and C (chips) axes to multiples of ``shards``.
+
+    Pad cells are ``int64.max`` so the sorted-cells invariant that
+    ``searchsorted`` relies on survives; pad chip rows have zero rings, so
+    the ray-crossing test can never report them as hits.
+    """
+    U = int(index.cells.shape[0])
+    C = int(index.chip_geom.shape[0])
+    du = _round_up(U, shards) - U
+    dc = _round_up(C, shards) - C
+    if not du and not dc:
+        return index
+    b = index.border
+    return ChipIndex(
+        cells=jnp.pad(index.cells, (0, du), constant_values=_I64_MAX),
+        chip_rows=jnp.pad(index.chip_rows, ((0, du), (0, 0)), constant_values=-1),
+        chip_geom=jnp.pad(index.chip_geom, (0, dc)),
+        chip_core=jnp.pad(index.chip_core, (0, dc)),
+        border=DeviceGeometry(
+            verts=jnp.pad(b.verts, ((0, dc), (0, 0), (0, 0), (0, 0))),
+            ring_len=jnp.pad(b.ring_len, ((0, dc), (0, 0))),
+            ring_is_hole=jnp.pad(b.ring_is_hole, ((0, dc), (0, 0))),
+            n_rings=jnp.pad(b.n_rings, (0, dc)),
+            geom_type=jnp.pad(b.geom_type, (0, dc)),
+            shift=b.shift,
+        ),
+    )
+
+
+def _index_specs(spec) -> ChipIndex:
+    """A ChipIndex-shaped pytree of PartitionSpecs (shift stays replicated)."""
+    return ChipIndex(
+        cells=spec,
+        chip_rows=spec,
+        chip_geom=spec,
+        chip_core=spec,
+        border=DeviceGeometry(
+            verts=spec,
+            ring_len=spec,
+            ring_is_hole=spec,
+            n_rings=spec,
+            geom_type=spec,
+            shift=P(),
+        ),
+    )
+
+
+def _gather_index(idx: ChipIndex, axis_name: str) -> ChipIndex:
+    """All-gather every sharded leaf of the chip index over ``axis_name``.
+
+    Leading-axis shards were contiguous, so tiled all-gather reassembles the
+    arrays in their original row order and chip-row ids stay valid.
+    """
+
+    def g(x):
+        return lax.all_gather(x, axis_name, axis=0, tiled=True)
+
+    b = idx.border
+    return ChipIndex(
+        cells=g(idx.cells),
+        chip_rows=g(idx.chip_rows),
+        chip_geom=g(idx.chip_geom),
+        chip_core=g(idx.chip_core),
+        border=DeviceGeometry(
+            verts=g(b.verts),
+            ring_len=g(b.ring_len),
+            ring_is_hole=g(b.ring_is_hole),
+            n_rings=g(b.n_rings),
+            geom_type=g(b.geom_type),
+            shift=b.shift,
+        ),
+    )
+
+
+def distributed_join_step(mesh: Mesh, num_zones: int):
+    """Build the jitted full distributed join+aggregate step for ``mesh``.
+
+    Returns ``step(points, pcells, index) -> (match, zone_counts)`` where
+
+    - ``points``  (N, 2) shift-applied coords, N divisible by mesh size —
+      sharded over ``("dp", "cell")``;
+    - ``pcells``  (N,) int64 cell ids, sharded the same way;
+    - ``index``   a `pad_index_for_shards(ix, mesh.shape['cell'])` chip
+      index — leading axes sharded over ``"cell"``;
+    - ``match``   (N,) int32 matched polygon row (-1 none), sharded as input;
+    - ``zone_counts`` (num_zones,) int64, globally psum-reduced (replicated).
+    """
+    point_spec = P(("dp", "cell"))
+    index_spec = _index_specs(P("cell"))
+
+    def step(points, pcells, index):
+        full = _gather_index(index, "cell")
+        match = pip_join_points(points, pcells, full)
+        zone = jnp.where(match >= 0, match, num_zones).astype(jnp.int32)
+        counts = jax.ops.segment_sum(
+            jnp.ones_like(zone, dtype=jnp.int64), zone, num_segments=num_zones + 1
+        )[:num_zones]
+        counts = lax.psum(counts, ("dp", "cell"))
+        return match, counts
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(point_spec, point_spec, index_spec),
+        out_specs=(point_spec, P()),
+    )
+    return jax.jit(sharded)
+
+
+def pad_points(points: np.ndarray, cells: np.ndarray, multiple: int):
+    """Pad the point axis to ``multiple`` with never-matching sentinels."""
+    n = points.shape[0]
+    d = _round_up(n, multiple) - n
+    if not d:
+        return points, cells
+    return (
+        np.pad(points, ((0, d), (0, 0))),
+        np.pad(cells, (0, d), constant_values=-1),
+    )

@@ -1,0 +1,316 @@
+// C ABI for the host geometry engine (ctypes-consumed from Python).
+//
+// Exchange format: a geometry is a flat contour list — double* xy (2*nv),
+// int64* ring_off (nr+1) — even-odd semantics (shells and holes are both
+// just contours). Shell/hole nesting is reconstructed on the Python side.
+// All returned buffers are malloc'd and released via mg_free_result.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "martinez.cpp"
+
+namespace mg {
+
+static std::vector<Contour> toContours(const double* xy, const int64_t* ro,
+                                       int64_t nr) {
+  std::vector<Contour> cs;
+  cs.reserve((size_t)nr);
+  for (int64_t r = 0; r < nr; ++r) {
+    Contour c;
+    for (int64_t v = ro[r]; v < ro[r + 1]; ++v)
+      c.push_back({xy[2 * v], xy[2 * v + 1]});
+    // drop explicit closing vertex
+    if (c.size() >= 2 && c.front() == c.back()) c.pop_back();
+    if (c.size() >= 3) cs.push_back(std::move(c));
+  }
+  return cs;
+}
+
+// open polyline chains: keep short runs, no closing-vertex strip
+static std::vector<Contour> toChains(const double* xy, const int64_t* ro,
+                                     int64_t nr) {
+  std::vector<Contour> cs;
+  cs.reserve((size_t)nr);
+  for (int64_t r = 0; r < nr; ++r) {
+    Contour c;
+    for (int64_t v = ro[r]; v < ro[r + 1]; ++v)
+      c.push_back({xy[2 * v], xy[2 * v + 1]});
+    if (!c.empty()) cs.push_back(std::move(c));
+  }
+  return cs;
+}
+
+static double contourArea(const Contour& c) {
+  double a = 0;
+  for (size_t i = 0; i < c.size(); ++i) {
+    const Pt& p = c[i];
+    const Pt& q = c[(i + 1) % c.size()];
+    a += p.x * q.y - q.x * p.y;
+  }
+  return 0.5 * a;
+}
+
+static void dropSlivers(std::vector<Contour>& cs, double eps) {
+  cs.erase(std::remove_if(cs.begin(), cs.end(),
+                          [&](const Contour& c) {
+                            return std::abs(contourArea(c)) <= eps;
+                          }),
+           cs.end());
+}
+
+static int emit(const std::vector<Contour>& cs, double** out_xy,
+                int64_t** out_ro, int64_t* out_nv, int64_t* out_nr) {
+  int64_t nv = 0;
+  for (auto& c : cs) nv += (int64_t)c.size();
+  double* xy = (double*)malloc(sizeof(double) * 2 * std::max<int64_t>(nv, 1));
+  int64_t* ro = (int64_t*)malloc(sizeof(int64_t) * (cs.size() + 1));
+  if (!xy || !ro) { free(xy); free(ro); return -1; }
+  int64_t v = 0;
+  ro[0] = 0;
+  for (size_t r = 0; r < cs.size(); ++r) {
+    for (auto& p : cs[r]) {
+      xy[2 * v] = p.x;
+      xy[2 * v + 1] = p.y;
+      ++v;
+    }
+    ro[r + 1] = v;
+  }
+  *out_xy = xy;
+  *out_ro = ro;
+  *out_nv = nv;
+  *out_nr = (int64_t)cs.size();
+  return 0;
+}
+
+// union of many contour-sets by binary reduction (keeps operand sizes small)
+static std::vector<Contour> unionMany(std::vector<std::vector<Contour>> items) {
+  if (items.empty()) return {};
+  while (items.size() > 1) {
+    std::vector<std::vector<Contour>> next;
+    for (size_t i = 0; i + 1 < items.size(); i += 2) {
+      std::vector<Contour> out;
+      boolOp(OP_UNION, items[i], items[i + 1], out);
+      next.push_back(std::move(out));
+    }
+    if (items.size() & 1) next.push_back(std::move(items.back()));
+    items.swap(next);
+  }
+  return std::move(items[0]);
+}
+
+static std::vector<Contour> capsules(const std::vector<Contour>& rings,
+                                     bool closed, double r, int quadSegs) {
+  // All arc vertices are sampled from ONE global angle lattice
+  // (2*pi*j/N, j integer). Capsules of adjacent edges then share *bit-
+  // identical* vertices on the arcs around their common endpoint, so the
+  // sweep sees exactly-coincident overlapping segments (its robust path)
+  // instead of segments that differ in the last ulp (its fragile path).
+  std::vector<std::vector<Contour>> caps;
+  int N = std::max(2, quadSegs) * 4;  // full-circle lattice resolution
+  std::vector<double> ux(N), uy(N);
+  for (int j = 0; j < N; ++j) {
+    double t = 2.0 * M_PI * j / N;
+    ux[j] = std::cos(t);
+    uy[j] = std::sin(t);
+  }
+  auto at = [&](const Pt& c, int j) -> Pt {
+    j = ((j % N) + N) % N;
+    return {c.x + r * ux[j], c.y + r * uy[j]};
+  };
+  for (auto& ring : rings) {
+    size_t n = ring.size();
+    size_t nedges = closed ? n : (n > 0 ? n - 1 : 0);
+    if (n == 1 && !closed) nedges = 1;  // lone point -> disc
+    for (size_t i = 0; i < nedges; ++i) {
+      Pt a = ring[i];
+      Pt b = ring[(i + 1) % n];
+      double dx = b.x - a.x, dy = b.y - a.y;
+      double len = std::sqrt(dx * dx + dy * dy);
+      Contour c;
+      if (len < 1e-300) {  // disc
+        for (int j = 0; j < N; ++j) c.push_back(at(a, j));
+      } else {
+        double base = std::atan2(dx, -dy);  // left-normal angle of the edge
+        int j0 = (int)std::lround(base / (2.0 * M_PI / N));
+        // CCW: arc around b from the +normal to the -normal (clockwise in
+        // angle = through the edge's forward direction), then back around a
+        for (int k = 0; k <= N / 2; ++k) c.push_back(at(b, j0 - k));
+        for (int k = 0; k <= N / 2; ++k) c.push_back(at(a, j0 - N / 2 - k));
+      }
+      caps.push_back({std::move(c)});
+    }
+  }
+  // union them here so callers get one flattened contour set
+  return unionMany(std::move(caps));
+}
+
+}  // namespace mg
+
+extern "C" {
+
+// ops: 0=intersection 1=union 2=difference 3=xor
+int mg_bool_op(int op, const double* axy, const int64_t* aro, int64_t anr,
+               const double* bxy, const int64_t* bro, int64_t bnr,
+               double** out_xy, int64_t** out_ro, int64_t* out_nv,
+               int64_t* out_nr) {
+  auto a = mg::toContours(axy, aro, anr);
+  auto b = mg::toContours(bxy, bro, bnr);
+  std::vector<mg::Contour> out;
+  mg::boolOp((mg::BoolOp)op, a, b, out);
+  mg::dropSlivers(out, 0.0);
+  return mg::emit(out, out_xy, out_ro, out_nv, out_nr);
+}
+
+// buffer a polygon (closed rings, even-odd) by dist (may be negative)
+int mg_buffer(const double* axy, const int64_t* aro, int64_t anr, int closed,
+              double dist, int quad_segs, double** out_xy, int64_t** out_ro,
+              int64_t* out_nv, int64_t* out_nr) {
+  auto rings = closed ? mg::toContours(axy, aro, anr)
+                      : mg::toChains(axy, aro, anr);
+  std::vector<mg::Contour> out;
+  if (dist == 0.0) {
+    if (closed) out = rings;  // zero-width buffer of lines/points is empty
+  } else if (!closed) {
+    // lines/points: buffer = union of edge capsules
+    if (dist > 0) out = mg::capsules(rings, false, dist, quad_segs);
+  } else if (dist > 0) {
+    auto caps = mg::capsules(rings, true, dist, quad_segs);
+    mg::boolOp(mg::OP_UNION, rings, caps, out);
+  } else {
+    auto caps = mg::capsules(rings, true, -dist, quad_segs);
+    mg::boolOp(mg::OP_DIFFERENCE, rings, caps, out);
+  }
+  mg::dropSlivers(out, 0.0);
+  return mg::emit(out, out_xy, out_ro, out_nv, out_nr);
+}
+
+// union of n geometries given as one flat contour list with a geometry
+// partition go (n+1 entries into rings)
+int mg_union_many(const double* xy, const int64_t* ro, int64_t nr,
+                  const int64_t* go, int64_t ng, double** out_xy,
+                  int64_t** out_ro, int64_t* out_nv, int64_t* out_nr) {
+  (void)nr;
+  std::vector<std::vector<mg::Contour>> items;
+  {
+    for (int64_t g = 0; g < ng; ++g) {
+      std::vector<mg::Contour> item;
+      for (int64_t r = go[g]; r < go[g + 1]; ++r) {
+        mg::Contour c;
+        for (int64_t v = ro[r]; v < ro[r + 1]; ++v)
+          c.push_back({xy[2 * v], xy[2 * v + 1]});
+        if (c.size() >= 2 && c.front() == c.back()) c.pop_back();
+        if (c.size() >= 3) item.push_back(std::move(c));
+      }
+      if (!item.empty()) items.push_back(std::move(item));
+    }
+  }
+  auto out = mg::unionMany(std::move(items));
+  mg::dropSlivers(out, 0.0);
+  return mg::emit(out, out_xy, out_ro, out_nv, out_nr);
+}
+
+void mg_free_result(double* xy, int64_t* ro) {
+  free(xy);
+  free(ro);
+}
+
+// Andrew monotone chain; returns hull size, writes CCW hull into out (cap 2n)
+int64_t mg_convex_hull(const double* xy, int64_t n, double* out) {
+  std::vector<mg::Pt> pts(n);
+  for (int64_t i = 0; i < n; ++i) pts[i] = {xy[2 * i], xy[2 * i + 1]};
+  std::sort(pts.begin(), pts.end(), [](const mg::Pt& a, const mg::Pt& b) {
+    return a.x < b.x || (a.x == b.x && a.y < b.y);
+  });
+  pts.erase(std::unique(pts.begin(), pts.end(),
+                        [](const mg::Pt& a, const mg::Pt& b) {
+                          return a.x == b.x && a.y == b.y;
+                        }),
+            pts.end());
+  int64_t m = (int64_t)pts.size();
+  if (m <= 2) {
+    for (int64_t i = 0; i < m; ++i) { out[2 * i] = pts[i].x; out[2 * i + 1] = pts[i].y; }
+    return m;
+  }
+  std::vector<mg::Pt> h(2 * m);
+  int64_t k = 0;
+  for (int64_t i = 0; i < m; ++i) {
+    while (k >= 2 && mg::signedArea(h[k - 2], h[k - 1], pts[i]) <= 0) --k;
+    h[k++] = pts[i];
+  }
+  int64_t lower = k + 1;
+  for (int64_t i = m - 2; i >= 0; --i) {
+    while (k >= lower && mg::signedArea(h[k - 2], h[k - 1], pts[i]) <= 0) --k;
+    h[k++] = pts[i];
+  }
+  --k;  // last point equals first
+  for (int64_t i = 0; i < k; ++i) { out[2 * i] = h[i].x; out[2 * i + 1] = h[i].y; }
+  return k;
+}
+
+// Douglas-Peucker: writes 0/1 keep flags; closed rings anchor at 0 and the
+// farthest-from-0 vertex
+int64_t mg_simplify_mask(const double* xy, int64_t n, double tol, int closed,
+                         uint8_t* keep) {
+  if (n <= 2) {
+    for (int64_t i = 0; i < n; ++i) keep[i] = 1;
+    return n;
+  }
+  std::memset(keep, 0, (size_t)n);
+  auto dist2seg = [&](int64_t i, int64_t a, int64_t b) {
+    double ax = xy[2 * a], ay = xy[2 * a + 1];
+    double bx = xy[2 * b], by = xy[2 * b + 1];
+    double px = xy[2 * i], py = xy[2 * i + 1];
+    double dx = bx - ax, dy = by - ay;
+    double l2 = dx * dx + dy * dy;
+    double t = l2 > 0 ? ((px - ax) * dx + (py - ay) * dy) / l2 : 0.0;
+    t = std::max(0.0, std::min(1.0, t));
+    double qx = ax + t * dx - px, qy = ay + t * dy - py;
+    return qx * qx + qy * qy;
+  };
+  double tol2 = tol * tol;
+  std::vector<std::pair<int64_t, int64_t>> stack;
+  auto dp = [&](int64_t a, int64_t b) {
+    stack.push_back({a, b});
+    while (!stack.empty()) {
+      auto [s, e] = stack.back();
+      stack.pop_back();
+      double dmax = -1.0;
+      int64_t imax = -1;
+      for (int64_t i = s + 1; i < e; ++i) {
+        double d = dist2seg(i, s, e);
+        if (d > dmax) { dmax = d; imax = i; }
+      }
+      if (imax >= 0 && dmax > tol2) {
+        keep[imax] = 1;
+        stack.push_back({s, imax});
+        stack.push_back({imax, e});
+      }
+    }
+  };
+  if (closed) {
+    // anchor: vertex 0 and the farthest vertex from it
+    double dmax = -1;
+    int64_t imax = n / 2;
+    for (int64_t i = 1; i < n; ++i) {
+      double dx = xy[2 * i] - xy[0], dy = xy[2 * i + 1] - xy[1];
+      double d = dx * dx + dy * dy;
+      if (d > dmax) { dmax = d; imax = i; }
+    }
+    keep[0] = keep[imax] = 1;
+    dp(0, imax);
+    dp(imax, n - 1);
+    keep[n - 1] = 1;  // ring input arrives open; last vertex stays
+  } else {
+    keep[0] = keep[n - 1] = 1;
+    dp(0, n - 1);
+  }
+  int64_t cnt = 0;
+  for (int64_t i = 0; i < n; ++i) cnt += keep[i];
+  return cnt;
+}
+}

@@ -1,0 +1,441 @@
+// Martinez–Rueda–Feito sweep-line boolean operations on polygons.
+//
+// Role in the framework: the host-side exact-geometry engine. The reference
+// delegates intersection/union/difference to JTS
+// (core/geometry/MosaicGeometryJTS.scala:61-101); here the same capability is
+// a from-scratch C++ implementation of the Martinez 2009 algorithm
+// ("A new algorithm for computing Boolean operations on polygons"), the
+// standard sweep approach: subdivide segments at intersections while
+// annotating each with in/out transition flags for both operands, select the
+// result edges per operation, then stitch them into closed contours.
+//
+// Input/output are flat contour lists (rings); shell/hole nesting is decided
+// by the caller (even-odd containment), which keeps this file free of any
+// polygon-with-holes bookkeeping.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <deque>
+#include <queue>
+#include <set>
+#include <vector>
+
+namespace mg {
+
+struct Pt {
+  double x, y;
+  bool operator==(const Pt& o) const { return x == o.x && y == o.y; }
+};
+
+static inline double signedArea(const Pt& p0, const Pt& p1, const Pt& p2) {
+  return (p0.x - p2.x) * (p1.y - p2.y) - (p1.x - p2.x) * (p0.y - p2.y);
+}
+
+enum BoolOp { OP_INTERSECTION = 0, OP_UNION = 1, OP_DIFFERENCE = 2, OP_XOR = 3 };
+enum EdgeType { NORMAL, NON_CONTRIBUTING, SAME_TRANSITION, DIFFERENT_TRANSITION };
+
+struct SweepEvent {
+  Pt p;
+  bool left = false;
+  SweepEvent* other = nullptr;
+  bool isSubject = false;
+  EdgeType type = NORMAL;
+  bool inOut = false;       // in-out transition for this event's own polygon
+  bool otherInOut = false;  // ditto w.r.t. the other polygon
+  SweepEvent* prevInResult = nullptr;
+  bool inResult = false;
+  int pos = 0;          // index into resultEvents during contour stitching
+  int64_t id = 0;       // creation order; strict-weak-order tiebreak
+  int contourId = 0;    // input contour (collinear tiebreak)
+
+  bool isBelow(const Pt& q) const {
+    return left ? signedArea(p, other->p, q) > 0
+                : signedArea(other->p, p, q) > 0;
+  }
+  bool isAbove(const Pt& q) const { return !isBelow(q); }
+  bool isVertical() const { return p.x == other->p.x; }
+};
+
+// Priority order for the event queue (and final result ordering): left-to-
+// right, bottom-to-top, right endpoints before left, lower segment first.
+static int compareEvents(const SweepEvent* e1, const SweepEvent* e2) {
+  if (e1->p.x > e2->p.x) return 1;
+  if (e1->p.x < e2->p.x) return -1;
+  if (e1->p.y != e2->p.y) return e1->p.y > e2->p.y ? 1 : -1;
+  if (e1->left != e2->left) return e1->left ? 1 : -1;
+  if (signedArea(e1->p, e1->other->p, e2->other->p) != 0.0)
+    return !e1->isBelow(e2->other->p) ? 1 : -1;
+  return (!e1->isSubject && e2->isSubject) ? 1 : -1;
+}
+
+struct QueueCmp {
+  // std::priority_queue is a max-heap: "less" = lower priority = later.
+  bool operator()(const SweepEvent* a, const SweepEvent* b) const {
+    int c = compareEvents(a, b);
+    if (c != 0) return c > 0;
+    return a->id > b->id;
+  }
+};
+
+// Status-line (sweep-line) vertical order of segments.
+struct SegmentCmp {
+  bool operator()(const SweepEvent* le1, const SweepEvent* le2) const {
+    if (le1 == le2) return false;
+    if (signedArea(le1->p, le1->other->p, le2->p) != 0.0 ||
+        signedArea(le1->p, le1->other->p, le2->other->p) != 0.0) {
+      // not collinear
+      if (le1->p == le2->p) return le1->isBelow(le2->other->p);
+      if (le1->p.x == le2->p.x) return le1->p.y < le2->p.y;
+      if (compareEvents(le1, le2) == 1) return le2->isAbove(le1->p);
+      return le1->isBelow(le2->p);
+    }
+    // collinear segments
+    if (le1->isSubject == le2->isSubject) {
+      if (le1->p == le2->p) {
+        if (le1->other->p == le2->other->p) return le1->id < le2->id;
+        return le1->contourId < le2->contourId;
+      }
+    } else {
+      return le1->isSubject;
+    }
+    return compareEvents(le1, le2) == -1;
+  }
+};
+
+struct Sweeper {
+  std::deque<SweepEvent> pool;  // stable addresses
+  std::priority_queue<SweepEvent*, std::vector<SweepEvent*>, QueueCmp> queue;
+  std::vector<SweepEvent*> sorted;
+  int64_t nextId = 0;
+
+  SweepEvent* make(const Pt& p, bool left, bool isSubject, int contourId) {
+    pool.push_back(SweepEvent{});
+    SweepEvent* e = &pool.back();
+    e->p = p;
+    e->left = left;
+    e->isSubject = isSubject;
+    e->id = nextId++;
+    e->contourId = contourId;
+    return e;
+  }
+
+  void addSegment(const Pt& a, const Pt& b, bool isSubject, int contourId) {
+    if (a == b) return;  // zero-length edges contribute nothing
+    SweepEvent* e1 = make(a, true, isSubject, contourId);
+    SweepEvent* e2 = make(b, true, isSubject, contourId);
+    e1->other = e2;
+    e2->other = e1;
+    if (compareEvents(e1, e2) < 0) e2->left = false;
+    else e1->left = false;
+    queue.push(e1);
+    queue.push(e2);
+  }
+
+  void divideSegment(SweepEvent* le, const Pt& p) {
+    SweepEvent* r = make(p, false, le->isSubject, le->contourId);
+    SweepEvent* l = make(p, true, le->isSubject, le->contourId);
+    r->other = le;
+    l->other = le->other;
+    if (compareEvents(l, le->other) > 0) {  // rounding produced a flip
+      le->other->left = true;
+      l->left = false;
+    }
+    le->other->other = l;
+    le->other = r;
+    queue.push(l);
+    queue.push(r);
+  }
+};
+
+// Segment intersection: returns number of intersection points (0, 1, or 2
+// for collinear overlap), writing them to i0/i1.
+static int findIntersection(const Pt& a0, const Pt& a1, const Pt& b0,
+                            const Pt& b1, Pt& i0, Pt& i1) {
+  double vax = a1.x - a0.x, vay = a1.y - a0.y;
+  double vbx = b1.x - b0.x, vby = b1.y - b0.y;
+  double ex = b0.x - a0.x, ey = b0.y - a0.y;
+  double kross = vax * vby - vay * vbx;
+  double sqrKross = kross * kross;
+  double sqrLenA = vax * vax + vay * vay;
+  double sqrLenB = vbx * vbx + vby * vby;
+  const double sqrEps = 1e-24;
+  if (sqrKross > sqrEps * sqrLenA * sqrLenB) {
+    double s = (ex * vby - ey * vbx) / kross;
+    if (s < 0 || s > 1) return 0;
+    double t = (ex * vay - ey * vax) / kross;
+    if (t < 0 || t > 1) return 0;
+    i0 = {a0.x + s * vax, a0.y + s * vay};
+    // snap to endpoints to avoid drift
+    auto snap = [&](const Pt& q) {
+      if (std::abs(i0.x - q.x) < 1e-15 && std::abs(i0.y - q.y) < 1e-15) i0 = q;
+    };
+    snap(a0); snap(a1); snap(b0); snap(b1);
+    return 1;
+  }
+  double sqrLenE = ex * ex + ey * ey;
+  double krossE = ex * vay - ey * vax;
+  if (krossE * krossE > sqrEps * sqrLenA * sqrLenE) return 0;  // parallel apart
+  // collinear: project b onto a's parameter space
+  double s0 = (vax * ex + vay * ey) / sqrLenA;
+  double s1 = s0 + (vax * vbx + vay * vby) / sqrLenA;
+  double smin = std::min(s0, s1), smax = std::max(s0, s1);
+  double lo = std::max(0.0, smin), hi = std::min(1.0, smax);
+  if (lo > hi) return 0;
+  auto at = [&](double s) -> Pt {
+    if (s <= 0) return a0;
+    if (s >= 1) return a1;
+    return {a0.x + s * vax, a0.y + s * vay};
+  };
+  i0 = at(lo);
+  if (lo == hi) return 1;
+  i1 = at(hi);
+  return 2;
+}
+
+static bool inResultFlag(const SweepEvent* ev, BoolOp op) {
+  switch (ev->type) {
+    case NORMAL:
+      switch (op) {
+        case OP_INTERSECTION: return !ev->otherInOut;
+        case OP_UNION: return ev->otherInOut;
+        case OP_DIFFERENCE:
+          return (ev->isSubject && ev->otherInOut) ||
+                 (!ev->isSubject && !ev->otherInOut);
+        case OP_XOR: return true;
+      }
+      return false;
+    case SAME_TRANSITION:
+      return op == OP_INTERSECTION || op == OP_UNION;
+    case DIFFERENT_TRANSITION:
+      return op == OP_DIFFERENCE;
+    case NON_CONTRIBUTING:
+      return false;
+  }
+  return false;
+}
+
+static void computeFields(SweepEvent* ev, SweepEvent* prev, BoolOp op) {
+  if (prev == nullptr) {
+    ev->inOut = false;
+    ev->otherInOut = true;
+  } else if (ev->isSubject == prev->isSubject) {
+    ev->inOut = !prev->inOut;
+    ev->otherInOut = prev->otherInOut;
+  } else {
+    ev->inOut = !prev->otherInOut;
+    ev->otherInOut = prev->isVertical() ? !prev->inOut : prev->inOut;
+  }
+  if (prev != nullptr) {
+    ev->prevInResult =
+        (!inResultFlag(prev, op) || prev->isVertical()) ? prev->prevInResult
+                                                        : prev;
+  }
+  ev->inResult = inResultFlag(ev, op);
+}
+
+// returns 0 = no change, 2 = overlap (fields of both must be recomputed),
+// 1/3 = segments divided
+static int possibleIntersection(SweepEvent* se1, SweepEvent* se2, Sweeper& sw) {
+  Pt i0{}, i1{};
+  int n = findIntersection(se1->p, se1->other->p, se2->p, se2->other->p, i0, i1);
+  if (n == 0) return 0;
+  if (n == 1 && (se1->p == se2->p || se1->other->p == se2->other->p)) return 0;
+  if (n == 2 && se1->isSubject == se2->isSubject) {
+    // self-overlap within one operand: ignore (inputs may carry duplicate
+    // edges from degenerate rings; treating them as non-contributing is safe)
+    se2->type = NON_CONTRIBUTING;
+    return 0;
+  }
+  if (n == 1) {
+    if (!(se1->p == i0) && !(se1->other->p == i0)) sw.divideSegment(se1, i0);
+    if (!(se2->p == i0) && !(se2->other->p == i0)) sw.divideSegment(se2, i0);
+    return 1;
+  }
+  // the segments overlap
+  std::vector<SweepEvent*> events;
+  bool leftCoincide = (se1->p == se2->p);
+  bool rightCoincide = (se1->other->p == se2->other->p);
+  if (!leftCoincide) {
+    if (compareEvents(se1, se2) > 0) { events.push_back(se2); events.push_back(se1); }
+    else { events.push_back(se1); events.push_back(se2); }
+  }
+  if (!rightCoincide) {
+    if (compareEvents(se1->other, se2->other) > 0) {
+      events.push_back(se2->other); events.push_back(se1->other);
+    } else {
+      events.push_back(se1->other); events.push_back(se2->other);
+    }
+  }
+  if ((leftCoincide && rightCoincide) || leftCoincide) {
+    se2->type = NON_CONTRIBUTING;
+    se1->type = (se2->inOut == se1->inOut) ? SAME_TRANSITION : DIFFERENT_TRANSITION;
+    if (leftCoincide && !rightCoincide)
+      sw.divideSegment(events[1]->other, events[0]->p);
+    return 2;
+  }
+  if (rightCoincide) {
+    sw.divideSegment(events[0], events[1]->p);
+    return 3;
+  }
+  if (events[0] != events[3]->other) {
+    sw.divideSegment(events[0], events[1]->p);
+    sw.divideSegment(events[1], events[2]->p);
+    return 3;
+  }
+  // one segment fully contains the other
+  sw.divideSegment(events[0], events[1]->p);
+  sw.divideSegment(events[3]->other, events[2]->p);
+  return 3;
+}
+
+using Contour = std::vector<Pt>;
+
+static void connectEdges(std::vector<SweepEvent*>& sorted, BoolOp op,
+                         std::vector<Contour>& out) {
+  std::vector<SweepEvent*> result;
+  result.reserve(sorted.size());
+  for (SweepEvent* ev : sorted) {
+    if ((ev->left && ev->inResult) || (!ev->left && ev->other->inResult))
+      result.push_back(ev);
+  }
+  // re-sort: divisions can leave the collected order slightly stale
+  bool sortedFlag = false;
+  while (!sortedFlag) {
+    sortedFlag = true;
+    for (size_t i = 0; i + 1 < result.size(); ++i) {
+      if (compareEvents(result[i], result[i + 1]) == 1) {
+        std::swap(result[i], result[i + 1]);
+        sortedFlag = false;
+      }
+    }
+  }
+  for (size_t i = 0; i < result.size(); ++i) result[i]->pos = (int)i;
+  for (size_t i = 0; i < result.size(); ++i) {
+    SweepEvent* ev = result[i];
+    if (!ev->left) {
+      int tmp = ev->pos;
+      ev->pos = ev->other->pos;
+      ev->other->pos = tmp;
+    }
+  }
+  std::vector<bool> processed(result.size(), false);
+  for (size_t i = 0; i < result.size(); ++i) {
+    if (processed[i]) continue;
+    Contour contour;
+    Pt initial = result[i]->p;
+    contour.push_back(initial);
+    size_t pos = i;
+    while (true) {
+      processed[pos] = true;
+      pos = (size_t)result[pos]->pos;  // jump to the partner endpoint
+      processed[pos] = true;
+      if (result[pos]->p == initial) break;
+      contour.push_back(result[pos]->p);
+      // find the next unprocessed event sharing this point
+      size_t next = pos;
+      bool found = false;
+      for (size_t j = pos + 1; j < result.size() && result[j]->p == result[pos]->p; ++j)
+        if (!processed[j]) { next = j; found = true; break; }
+      if (!found) {
+        for (size_t j = pos; j-- > 0 && result[j]->p == result[pos]->p;)
+          if (!processed[j]) { next = j; found = true; break; }
+      }
+      if (!found) break;  // open chain (degenerate); emit what we have
+      pos = next;
+    }
+    if (contour.size() >= 3) out.push_back(std::move(contour));
+  }
+}
+
+// rings: flat array of contours for subject (ns rings) then clipping.
+void boolOp(BoolOp op, const std::vector<Contour>& subject,
+            const std::vector<Contour>& clipping, std::vector<Contour>& out) {
+  // trivial cases
+  bool subjEmpty = subject.empty(), clipEmpty = clipping.empty();
+  if (subjEmpty || clipEmpty) {
+    if (op == OP_INTERSECTION) return;
+    if (op == OP_DIFFERENCE) { out = subject; return; }
+    out = subjEmpty ? clipping : subject;
+    return;
+  }
+  double sxmin = 1e300, sxmax = -1e300, symin = 1e300, symax = -1e300;
+  double cxmin = 1e300, cxmax = -1e300, cymin = 1e300, cymax = -1e300;
+  for (auto& c : subject)
+    for (auto& p : c) {
+      sxmin = std::min(sxmin, p.x); sxmax = std::max(sxmax, p.x);
+      symin = std::min(symin, p.y); symax = std::max(symax, p.y);
+    }
+  for (auto& c : clipping)
+    for (auto& p : c) {
+      cxmin = std::min(cxmin, p.x); cxmax = std::max(cxmax, p.x);
+      cymin = std::min(cymin, p.y); cymax = std::max(cymax, p.y);
+    }
+  if (sxmin > cxmax || cxmin > sxmax || symin > cymax || cymin > symax) {
+    if (op == OP_INTERSECTION) return;
+    if (op == OP_DIFFERENCE) { out = subject; return; }
+    out = subject;
+    out.insert(out.end(), clipping.begin(), clipping.end());
+    return;
+  }
+  double rightbound = std::min(sxmax, cxmax);
+
+  Sweeper sw;
+  int cid = 0;
+  for (auto& c : subject) {
+    ++cid;
+    for (size_t k = 0; k < c.size(); ++k)
+      sw.addSegment(c[k], c[(k + 1) % c.size()], true, cid);
+  }
+  for (auto& c : clipping) {
+    ++cid;
+    for (size_t k = 0; k < c.size(); ++k)
+      sw.addSegment(c[k], c[(k + 1) % c.size()], false, cid);
+  }
+
+  std::set<SweepEvent*, SegmentCmp> sl;
+  while (!sw.queue.empty()) {
+    SweepEvent* ev = sw.queue.top();
+    sw.queue.pop();
+    sw.sorted.push_back(ev);
+    // optimization: beyond the overlap zone nothing can change the result
+    if ((op == OP_INTERSECTION && ev->p.x > rightbound) ||
+        (op == OP_DIFFERENCE && ev->p.x > sxmax))
+      break;
+    if (ev->left) {
+      auto ins = sl.insert(ev);
+      auto it = ins.first;
+      auto prev = it, next = it;
+      SweepEvent* prevEv = (it == sl.begin()) ? nullptr : *(--prev);
+      computeFields(ev, prevEv, op);
+      ++next;
+      if (next != sl.end()) {
+        if (possibleIntersection(ev, *next, sw) == 2) {
+          computeFields(ev, prevEv, op);
+          computeFields(*next, ev, op);
+        }
+      }
+      if (prevEv != nullptr) {
+        if (possibleIntersection(prevEv, ev, sw) == 2) {
+          auto pprev = prev;
+          SweepEvent* prevPrevEv = (prev == sl.begin()) ? nullptr : *(--pprev);
+          computeFields(prevEv, prevPrevEv, op);
+          computeFields(ev, prevEv, op);
+        }
+      }
+    } else {
+      SweepEvent* le = ev->other;
+      auto it = sl.find(le);
+      if (it == sl.end()) continue;  // robustness: comparator drift
+      auto prev = it, next = it;
+      SweepEvent* prevEv = (it == sl.begin()) ? nullptr : *(--prev);
+      ++next;
+      SweepEvent* nextEv = (next == sl.end()) ? nullptr : *next;
+      sl.erase(it);
+      if (prevEv && nextEv) possibleIntersection(prevEv, nextEv, sw);
+    }
+  }
+  connectEdges(sw.sorted, op, out);
+}
+
+}  // namespace mg

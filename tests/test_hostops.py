@@ -1,0 +1,191 @@
+"""Host geometry engine tests: boolean ops, buffer, hull, simplify.
+
+No third-party oracle exists in this environment (no shapely/JTS), so
+correctness is established through *identities*:
+
+- membership sampling: for random probe points,
+  ``p ∈ A op B  ⇔  (p ∈ A) op (p ∈ B)`` via the numpy even-odd oracle;
+- area conservation: ``|A∩B| + |A\\B| = |A|`` and
+  ``|A∪B| = |A| + |B| - |A∩B|``;
+- buffer monotonicity and disc-area convergence.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry import hostops, oracle
+from mosaic_tpu.core.geometry.wkt import from_wkt
+from mosaic_tpu.core.types import GeometryType
+
+
+def _probe(col, g, pts):
+    return oracle.contains_points(col, g, pts)
+
+
+def _rand_poly(rng, cx, cy, rmax=2.0, verts=12):
+    ang = np.sort(rng.uniform(0, 2 * np.pi, verts))
+    rad = rng.uniform(0.3, 1.0, verts) * rmax
+    ring = np.column_stack([cx + rad * np.cos(ang), cy + rad * np.sin(ang)])
+    from mosaic_tpu.core.types import GeometryBuilder
+
+    b = GeometryBuilder()
+    b.add_geometry(GeometryType.POLYGON, [[ring]], 4326)
+    return b.build()
+
+
+SQ1 = "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"
+SQ2 = "POLYGON ((2 2, 6 2, 6 6, 2 6, 2 2))"
+HOLEY = "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (3 3, 3 7, 7 7, 7 3, 3 3))"
+FAR = "POLYGON ((100 100, 101 100, 101 101, 100 101, 100 100))"
+
+
+class TestBoolOps:
+    def test_square_intersection_known(self):
+        a, b = from_wkt([SQ1]), from_wkt([SQ2])
+        out = hostops.intersection(a, b)
+        assert oracle.area(out)[0] == pytest.approx(4.0)
+
+    def test_square_union_known(self):
+        a, b = from_wkt([SQ1]), from_wkt([SQ2])
+        out = hostops.union(a, b)
+        assert oracle.area(out)[0] == pytest.approx(16 + 16 - 4)
+
+    def test_square_difference_known(self):
+        a, b = from_wkt([SQ1]), from_wkt([SQ2])
+        out = hostops.difference(a, b)
+        assert oracle.area(out)[0] == pytest.approx(16 - 4)
+
+    def test_xor_known(self):
+        a, b = from_wkt([SQ1]), from_wkt([SQ2])
+        out = hostops.sym_difference(a, b)
+        assert oracle.area(out)[0] == pytest.approx(16 + 16 - 2 * 4)
+
+    def test_disjoint(self):
+        a, b = from_wkt([SQ1]), from_wkt([FAR])
+        assert oracle.area(hostops.intersection(a, b))[0] == pytest.approx(0.0)
+        assert oracle.area(hostops.union(a, b))[0] == pytest.approx(17.0)
+        assert oracle.area(hostops.difference(a, b))[0] == pytest.approx(16.0)
+
+    def test_hole_semantics(self):
+        a, b = from_wkt([HOLEY]), from_wkt([SQ1])
+        out = hostops.intersection(a, b)
+        # SQ1 ∩ HOLEY: 4x4 square minus the overlapping hole part (3..4)^2
+        assert oracle.area(out)[0] == pytest.approx(16 - 1)
+
+    def test_contained(self):
+        a = from_wkt([SQ1])
+        b = from_wkt(["POLYGON ((1 1, 2 1, 2 2, 1 2, 1 1))"])
+        assert oracle.area(hostops.intersection(a, b))[0] == pytest.approx(1.0)
+        assert oracle.area(hostops.difference(a, b))[0] == pytest.approx(15.0)
+        out = hostops.difference(a, b)
+        # difference must carve a hole
+        assert out.num_rings == 2
+
+    def test_identical(self):
+        a = from_wkt([SQ1])
+        assert oracle.area(hostops.intersection(a, a))[0] == pytest.approx(16.0)
+        assert oracle.area(hostops.union(a, a))[0] == pytest.approx(16.0)
+        assert oracle.area(hostops.difference(a, a))[0] == pytest.approx(0.0)
+
+    def test_shared_edge(self):
+        a = from_wkt(["POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))"])
+        b = from_wkt(["POLYGON ((2 0, 4 0, 4 2, 2 2, 2 0))"])
+        assert oracle.area(hostops.union(a, b))[0] == pytest.approx(8.0)
+        assert oracle.area(hostops.intersection(a, b))[0] == pytest.approx(0.0)
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_membership_and_areas(self, seed):
+        rng = np.random.default_rng(seed)
+        a = _rand_poly(rng, 0.0, 0.0)
+        b = _rand_poly(rng, rng.uniform(-1, 1), rng.uniform(-1, 1))
+        inter = hostops.intersection(a, b)
+        uni = hostops.union(a, b)
+        diff = hostops.difference(a, b)
+        ai, au, ad = (oracle.area(c)[0] for c in (inter, uni, diff))
+        aa, ab = oracle.area(a)[0], oracle.area(b)[0]
+        assert ai + ad == pytest.approx(aa, rel=1e-9, abs=1e-12)
+        assert au == pytest.approx(aa + ab - ai, rel=1e-9, abs=1e-12)
+        pts = rng.uniform(-3, 3, size=(400, 2))
+        in_a = _probe(a, 0, pts)
+        in_b = _probe(b, 0, pts)
+        got_i = _probe(inter, 0, pts)
+        got_u = _probe(uni, 0, pts)
+        got_d = _probe(diff, 0, pts)
+        # boundary-grazing probes can disagree; demand near-total agreement
+        assert np.mean(got_i == (in_a & in_b)) > 0.995
+        assert np.mean(got_u == (in_a | in_b)) > 0.995
+        assert np.mean(got_d == (in_a & ~in_b)) > 0.995
+
+
+class TestUnion:
+    def test_union_all(self):
+        col = from_wkt([SQ1, SQ2, FAR])
+        out = hostops.union_all(col)
+        assert oracle.area(out)[0] == pytest.approx(16 + 16 - 4 + 1)
+
+    def test_unary_union(self):
+        col = from_wkt(
+            ["MULTIPOLYGON (((0 0, 4 0, 4 4, 0 4, 0 0)), ((2 2, 6 2, 6 6, 2 6, 2 2)))"]
+        )
+        out = hostops.unary_union(col)
+        assert oracle.area(out)[0] == pytest.approx(28.0)
+
+
+class TestBuffer:
+    def test_point_buffer_is_disc(self):
+        col = from_wkt(["POINT (1 1)"])
+        out = hostops.buffer(col, 2.0, quad_segs=16)
+        assert oracle.area(out)[0] == pytest.approx(np.pi * 4, rel=0.01)
+
+    def test_polygon_buffer_grows(self):
+        col = from_wkt([SQ1])
+        out = hostops.buffer(col, 1.0, quad_segs=8)
+        # 4x4 square + 1: area = 16 + perimeter*1 + pi*1^2
+        assert oracle.area(out)[0] == pytest.approx(16 + 16 + np.pi, rel=0.01)
+
+    def test_negative_buffer_erodes(self):
+        col = from_wkt([SQ1])
+        out = hostops.buffer(col, -1.0)
+        assert oracle.area(out)[0] == pytest.approx(4.0, rel=0.01)
+
+    def test_line_buffer(self):
+        col = from_wkt(["LINESTRING (0 0, 10 0)"])
+        out = hostops.buffer(col, 1.0, quad_segs=16)
+        assert oracle.area(out)[0] == pytest.approx(20 + np.pi, rel=0.01)
+
+    def test_buffer_roundtrip_contains_original(self):
+        rng = np.random.default_rng(5)
+        col = _rand_poly(rng, 0, 0)
+        out = hostops.buffer(col, 0.5)
+        pts = rng.uniform(-2.5, 2.5, size=(300, 2))
+        in_orig = _probe(col, 0, pts)
+        in_buf = _probe(out, 0, pts)
+        assert not np.any(in_orig & ~in_buf)
+
+
+class TestHullSimplify:
+    def test_hull_of_square_plus_inner(self):
+        col = from_wkt(["MULTIPOINT ((0 0), (4 0), (4 4), (0 4), (2 2))"])
+        out = hostops.convex_hull(col)
+        assert out.geometry_type(0) == GeometryType.POLYGON
+        assert oracle.area(out)[0] == pytest.approx(16.0)
+
+    def test_hull_collinear(self):
+        col = from_wkt(["MULTIPOINT ((0 0), (1 1), (2 2))"])
+        out = hostops.convex_hull(col)
+        assert out.geometry_type(0) == GeometryType.LINESTRING
+
+    def test_simplify_line(self):
+        col = from_wkt(["LINESTRING (0 0, 1 0.001, 2 0, 3 0.001, 4 0)"])
+        out = hostops.simplify(col, 0.01)
+        assert out.num_vertices == 2
+
+    def test_simplify_keeps_shape(self):
+        col = from_wkt(["LINESTRING (0 0, 1 1, 2 0, 3 1, 4 0)"])
+        out = hostops.simplify(col, 0.1)
+        assert out.num_vertices == 5
+
+    def test_simplify_ring_preserved(self):
+        col = from_wkt([SQ1])
+        out = hostops.simplify(col, 0.5)
+        assert oracle.area(out)[0] == pytest.approx(16.0)
