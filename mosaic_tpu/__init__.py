@@ -22,13 +22,25 @@ if not _os.environ.get("MOSAIC_TPU_NO_X64"):
     _jax.config.update("jax_enable_x64", True)
 
 from .core.types import GeometryBuilder, GeometryType, PackedGeometry, PaddedGeometry
+from .context import MosaicConfig, MosaicContext, index_system_factory
 
 __version__ = "0.1.0"
+
+
+def enable_mosaic(index_system="H3", geometry_backend="device", **kwargs):
+    """Build + install the process context (reference: Python
+    `enable_mosaic`, `python/mosaic/api/enable.py:13`)."""
+    return MosaicContext.build(index_system, geometry_backend, **kwargs)
+
 
 __all__ = [
     "GeometryBuilder",
     "GeometryType",
+    "MosaicConfig",
+    "MosaicContext",
     "PackedGeometry",
     "PaddedGeometry",
+    "enable_mosaic",
+    "index_system_factory",
     "__version__",
 ]

@@ -1,0 +1,312 @@
+"""Coordinate reference systems: transforms + validity bounds.
+
+Reference analogs: proj4j reprojection via ``mapXY``
+(`core/geometry/MosaicGeometry.scala:102-128`, `ST_Transform`/`ST_UpdateSRID`)
+and the CRS validity envelopes loaded from ``CRSBounds.csv``
+(`core/crs/CRSBoundsProvider.scala:18-100`) behind ``st_hasvalidcoordinates``.
+
+Instead of wrapping a host projection library per row, the transforms here are
+closed-form array math written against a swappable array namespace ``xp`` —
+pass ``numpy`` for the exact host path (float64) or ``jax.numpy`` for a
+jittable device path that fuses into surrounding XLA programs (e.g.
+``st_transform`` straight into ``grid_longlatascellid``). Iterative inverses
+(footpoint latitude, geodetic height) use fixed iteration counts so they
+compile under ``jit`` with no data-dependent control flow.
+
+Supported SRIDs: 4326/4269 (geographic), 3857 (spherical Web Mercator),
+27700 (British National Grid: WGS84→OSGB36 Helmert + Airy 1830 transverse
+Mercator, OS Guide series formulas), 326xx/327xx (WGS84 UTM north/south).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# ellipsoids and datums
+# --------------------------------------------------------------------------
+
+WGS84_A = 6378137.0
+WGS84_F = 1.0 / 298.257223563
+AIRY_A = 6377563.396
+AIRY_B = 6356256.909
+
+# WGS84 -> OSGB36 7-parameter Helmert (OS Guide table; ~5 m accuracy)
+_OSGB_T = (-446.448, 125.157, -542.060)
+_OSGB_S = 20.4894e-6
+_OSGB_R = tuple(
+    math.radians(sec / 3600.0) for sec in (-0.1502, -0.2470, -0.8421)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TMParams:
+    """Transverse Mercator constants (one projected CRS)."""
+
+    a: float
+    b: float
+    f0: float  # central-meridian scale
+    lat0: float  # radians
+    lon0: float  # radians
+    e0: float  # false easting
+    n0: float  # false northing
+
+    @property
+    def e2(self) -> float:
+        return (self.a**2 - self.b**2) / self.a**2
+
+    @property
+    def n(self) -> float:
+        return (self.a - self.b) / (self.a + self.b)
+
+
+BNG_TM = TMParams(
+    a=AIRY_A,
+    b=AIRY_B,
+    f0=0.9996012717,
+    lat0=math.radians(49.0),
+    lon0=math.radians(-2.0),
+    e0=400000.0,
+    n0=-100000.0,
+)
+
+
+def _utm_tm(zone: int, south: bool) -> TMParams:
+    b = WGS84_A * (1.0 - WGS84_F)
+    return TMParams(
+        a=WGS84_A,
+        b=b,
+        f0=0.9996,
+        lat0=0.0,
+        lon0=math.radians(zone * 6.0 - 183.0),
+        e0=500000.0,
+        n0=10000000.0 if south else 0.0,
+    )
+
+
+# --------------------------------------------------------------------------
+# transverse Mercator (OS Guide / Snyder series; works for numpy and jnp)
+# --------------------------------------------------------------------------
+
+
+def _tm_meridional_arc(p: TMParams, lat, xp):
+    n = p.n
+    dl, sl = lat - p.lat0, lat + p.lat0
+    return (
+        p.b
+        * p.f0
+        * (
+            (1 + n + 1.25 * n**2 + 1.25 * n**3) * dl
+            - (3 * n + 3 * n**2 + 21.0 / 8.0 * n**3) * xp.sin(dl) * xp.cos(sl)
+            + (15.0 / 8.0 * (n**2 + n**3)) * xp.sin(2 * dl) * xp.cos(2 * sl)
+            - (35.0 / 24.0 * n**3) * xp.sin(3 * dl) * xp.cos(3 * sl)
+        )
+    )
+
+
+def tm_forward(p: TMParams, lonlat, xp=np):
+    """(N,2) lon/lat radians on the projection datum -> (N,2) easting/northing."""
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    e2 = p.e2
+    s, c, t = xp.sin(lat), xp.cos(lat), xp.tan(lat)
+    nu = p.a * p.f0 / xp.sqrt(1 - e2 * s * s)
+    rho = p.a * p.f0 * (1 - e2) * (1 - e2 * s * s) ** -1.5
+    eta2 = nu / rho - 1
+    m = _tm_meridional_arc(p, lat, xp)
+    one = m + p.n0
+    two = nu / 2 * s * c
+    three = nu / 24 * s * c**3 * (5 - t**2 + 9 * eta2)
+    three_a = nu / 720 * s * c**5 * (61 - 58 * t**2 + t**4)
+    four = nu * c
+    five = nu / 6 * c**3 * (nu / rho - t**2)
+    six = nu / 120 * c**5 * (5 - 18 * t**2 + t**4 + 14 * eta2 - 58 * t**2 * eta2)
+    dl = lon - p.lon0
+    northing = one + two * dl**2 + three * dl**4 + three_a * dl**6
+    easting = p.e0 + four * dl + five * dl**3 + six * dl**5
+    return xp.stack([easting, northing], axis=-1)
+
+
+def tm_inverse(p: TMParams, en, xp=np, iters: int = 8):
+    """(N,2) easting/northing -> (N,2) lon/lat radians on the datum."""
+    e, nn = en[..., 0], en[..., 1]
+    e2 = p.e2
+    lat = (nn - p.n0) / (p.a * p.f0) + p.lat0
+    # fixed-count footpoint iteration (jit-safe; converges in <5 rounds)
+    for _ in range(iters):
+        m = _tm_meridional_arc(p, lat, xp)
+        lat = lat + (nn - p.n0 - m) / (p.a * p.f0)
+    s, c, t = xp.sin(lat), xp.cos(lat), xp.tan(lat)
+    nu = p.a * p.f0 / xp.sqrt(1 - e2 * s * s)
+    rho = p.a * p.f0 * (1 - e2) * (1 - e2 * s * s) ** -1.5
+    eta2 = nu / rho - 1
+    seven = t / (2 * rho * nu)
+    eight = t / (24 * rho * nu**3) * (5 + 3 * t**2 + eta2 - 9 * t**2 * eta2)
+    nine = t / (720 * rho * nu**5) * (61 + 90 * t**2 + 45 * t**4)
+    ten = 1.0 / (c * nu)
+    eleven = 1.0 / (c * 6 * nu**3) * (nu / rho + 2 * t**2)
+    twelve = 1.0 / (c * 120 * nu**5) * (5 + 28 * t**2 + 24 * t**4)
+    twelve_a = (
+        1.0 / (c * 5040 * nu**7) * (61 + 662 * t**2 + 1320 * t**4 + 720 * t**6)
+    )
+    de = e - p.e0
+    lat_out = lat - seven * de**2 + eight * de**4 - nine * de**6
+    lon_out = (
+        p.lon0 + ten * de - eleven * de**3 + twelve * de**5 - twelve_a * de**7
+    )
+    return xp.stack([lon_out, lat_out], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# datum shift (geodetic <-> ECEF + Helmert)
+# --------------------------------------------------------------------------
+
+
+def _geodetic_to_ecef(lonlat, a, e2, xp):
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    s, c = xp.sin(lat), xp.cos(lat)
+    nu = a / xp.sqrt(1 - e2 * s * s)
+    x = nu * c * xp.cos(lon)
+    y = nu * c * xp.sin(lon)
+    z = nu * (1 - e2) * s
+    return x, y, z
+
+
+def _ecef_to_geodetic(x, y, z, a, e2, xp, iters: int = 6):
+    lon = xp.arctan2(y, x)
+    p = xp.sqrt(x * x + y * y)
+    lat = xp.arctan2(z, p * (1 - e2))
+    for _ in range(iters):
+        s = xp.sin(lat)
+        nu = a / xp.sqrt(1 - e2 * s * s)
+        lat = xp.arctan2(z + e2 * nu * s, p)
+    return xp.stack([lon, lat], axis=-1)
+
+
+def _helmert(x, y, z, t, s, r, sign, xp):
+    tx, ty, tz = (sign * v for v in t)
+    rx, ry, rz = (sign * v for v in r)
+    sc = 1.0 + sign * s
+    xo = tx + sc * x - rz * y + ry * z
+    yo = ty + rz * x + sc * y - rx * z
+    zo = tz - ry * x + rx * y + sc * z
+    return xo, yo, zo
+
+
+_WGS_E2 = WGS84_F * (2 - WGS84_F)
+_AIRY_E2 = (AIRY_A**2 - AIRY_B**2) / AIRY_A**2
+
+
+def wgs84_to_osgb36(lonlat, xp=np):
+    x, y, z = _geodetic_to_ecef(lonlat, WGS84_A, _WGS_E2, xp)
+    x, y, z = _helmert(x, y, z, _OSGB_T, _OSGB_S, _OSGB_R, +1.0, xp)
+    return _ecef_to_geodetic(x, y, z, AIRY_A, _AIRY_E2, xp)
+
+
+def osgb36_to_wgs84(lonlat, xp=np):
+    x, y, z = _geodetic_to_ecef(lonlat, AIRY_A, _AIRY_E2, xp)
+    x, y, z = _helmert(x, y, z, _OSGB_T, _OSGB_S, _OSGB_R, -1.0, xp)
+    return _ecef_to_geodetic(x, y, z, WGS84_A, _WGS_E2, xp)
+
+
+# --------------------------------------------------------------------------
+# SRID registry / dispatch
+# --------------------------------------------------------------------------
+
+_GEOGRAPHIC = {4326, 4269}  # NAD83 treated as WGS84 (<2 m, like proj4j default)
+
+
+def _is_utm(srid: int) -> bool:
+    return 32601 <= srid <= 32660 or 32701 <= srid <= 32760
+
+
+def supported(srid: int) -> bool:
+    return srid in _GEOGRAPHIC or srid in (3857, 27700) or _is_utm(srid)
+
+
+def to_wgs84(xy, srid: int, xp=np):
+    """(N,2) coords in `srid` -> (N,2) lon/lat degrees WGS84."""
+    if srid in _GEOGRAPHIC:
+        return xy
+    if srid == 3857:
+        lon = xy[..., 0] / WGS84_A
+        lat = 2 * xp.arctan(xp.exp(xy[..., 1] / WGS84_A)) - math.pi / 2
+        return xp.degrees(xp.stack([lon, lat], axis=-1))
+    if srid == 27700:
+        ll = tm_inverse(BNG_TM, xy, xp)
+        return xp.degrees(osgb36_to_wgs84(ll, xp))
+    if _is_utm(srid):
+        p = _utm_tm(srid % 100, south=srid >= 32701)
+        return xp.degrees(tm_inverse(p, xy, xp))
+    raise ValueError(f"unsupported SRID {srid}")
+
+
+def from_wgs84(lonlat_deg, srid: int, xp=np):
+    """(N,2) lon/lat degrees WGS84 -> (N,2) coords in `srid`."""
+    if srid in _GEOGRAPHIC:
+        return lonlat_deg
+    if srid == 3857:
+        lon = xp.radians(lonlat_deg[..., 0])
+        lat = xp.radians(lonlat_deg[..., 1])
+        x = WGS84_A * lon
+        y = WGS84_A * xp.log(xp.tan(math.pi / 4 + lat / 2))
+        return xp.stack([x, y], axis=-1)
+    if srid == 27700:
+        ll = wgs84_to_osgb36(xp.radians(lonlat_deg), xp)
+        return tm_forward(BNG_TM, ll, xp)
+    if _is_utm(srid):
+        p = _utm_tm(srid % 100, south=srid >= 32701)
+        return tm_forward(p, xp.radians(lonlat_deg), xp)
+    raise ValueError(f"unsupported SRID {srid}")
+
+
+def transform_points(xy, from_srid: int, to_srid: int, xp=np):
+    """(N,2) coordinate transform between any two supported SRIDs."""
+    if from_srid == to_srid:
+        return xy
+    return from_wgs84(to_wgs84(xy, from_srid, xp), to_srid, xp)
+
+
+# --------------------------------------------------------------------------
+# validity bounds (reference: CRSBounds.csv / CRSBoundsProvider)
+# --------------------------------------------------------------------------
+# Each entry: (geographic lon/lat bounds, projected-unit bounds). The
+# reference distinguishes "bounds" (lat/lon area of use) from
+# "reprojected_bounds" (same envelope in CRS units)
+# (`core/crs/CRSBounds.scala:15-37`).
+
+_BOUNDS: dict[int, tuple[tuple[float, float, float, float], tuple[float, float, float, float]]] = {
+    4326: ((-180, -90, 180, 90), (-180, -90, 180, 90)),
+    4269: ((-172.54, 23.81, -47.74, 86.46), (-172.54, 23.81, -47.74, 86.46)),
+    3857: (
+        (-180, -85.06, 180, 85.06),
+        (-20037508.34, -20048966.1, 20037508.34, 20048966.1),
+    ),
+    27700: ((-9.0, 49.75, 2.01, 61.01), (-104009.36, -16627.09, 688806.01, 1256558.45)),
+}
+
+
+def crs_bounds(srid: int, reprojected: bool) -> tuple[float, float, float, float]:
+    """Validity envelope: lon/lat area of use, or the same in CRS units."""
+    if srid in _BOUNDS:
+        geo, proj = _BOUNDS[srid]
+        return proj if reprojected else geo
+    if _is_utm(srid):
+        zone, south = srid % 100, srid >= 32701
+        lon0 = zone * 6 - 183
+        geo = (lon0 - 3.0, (-80.0 if south else 0.0), lon0 + 3.0, (0.0 if south else 84.0))
+        proj = (166021.44, 1116915.04 if south else 0.0, 833978.56, 10000000.0 if south else 9329005.18)
+        return proj if reprojected else geo
+    raise ValueError(f"no bounds for SRID {srid}")
+
+
+def parse_crs_code(code: "str | int") -> int:
+    """'EPSG:27700' | '27700' | 27700 -> 27700."""
+    if isinstance(code, int):
+        return code
+    c = code.strip().upper()
+    if c.startswith("EPSG:"):
+        c = c[5:]
+    return int(c)

@@ -168,7 +168,11 @@ def edges_intersect(ga: DeviceGeometry, gb: DeviceGeometry) -> jax.Array:
 
 def min_distance(ga: DeviceGeometry, gb: DeviceGeometry) -> jax.Array:
     """(Ga, Gb) min boundary distance (0 if boundaries cross). Interior
-    containment is NOT folded in here — `distance` below handles that."""
+    containment is NOT folded in here — `distance` below handles that.
+
+    Three masked terms so degenerate geometries work: vertex(a)→segment(b),
+    vertex(b)→segment(a), and vertex(a)→vertex(b) (the only nonempty term
+    for POINT×POINT, whose rings have no edges)."""
     a1, a2, am = _boundary_edges(ga)
     b1, b2, bm = _boundary_edges(gb)
     A, B = a1.shape[0], b1.shape[0]
@@ -176,19 +180,30 @@ def min_distance(ga: DeviceGeometry, gb: DeviceGeometry) -> jax.Array:
     amf = am.reshape(A, -1)
     b1f, b2f = b1.reshape(B, -1, 2), b2.reshape(B, -1, 2)
     bmf = bm.reshape(B, -1)
+    va, vam = ga.verts.reshape(A, -1, 2), ga.vert_mask.reshape(A, -1)
+    vb, vbm = gb.verts.reshape(B, -1, 2), gb.vert_mask.reshape(B, -1)
 
     # vertex-of-a to segment-of-b
     d_ab = _point_seg_dist2(
-        a1f[:, None, :, None, :], b1f[None, :, None, :, :], b2f[None, :, None, :, :]
+        va[:, None, :, None, :], b1f[None, :, None, :, :], b2f[None, :, None, :, :]
     )
-    m_ab = amf[:, None, :, None] & bmf[None, :, None, :]
-    d_ab = jnp.where(m_ab, d_ab, _BIG)
+    d_ab = jnp.where(vam[:, None, :, None] & bmf[None, :, None, :], d_ab, _BIG)
     # vertex-of-b to segment-of-a
     d_ba = _point_seg_dist2(
-        b1f[None, :, None, :, :], a1f[:, None, :, None, :], a2f[:, None, :, None, :]
+        vb[None, :, :, None, :], a1f[:, None, None, :, :], a2f[:, None, None, :, :]
     )
-    d_ba = jnp.where(m_ab, d_ba, _BIG)
-    d2 = jnp.minimum(jnp.min(d_ab, axis=(-2, -1)), jnp.min(d_ba, axis=(-2, -1)))
+    d_ba = jnp.where(vbm[None, :, :, None] & amf[:, None, None, :], d_ba, _BIG)
+    # vertex-of-a to vertex-of-b
+    dv = jnp.sum(
+        (va[:, None, :, None, :] - vb[None, :, None, :, :]) ** 2, axis=-1
+    )
+    dv = jnp.where(vam[:, None, :, None] & vbm[None, :, None, :], dv, _BIG)
+    d2 = jnp.minimum(
+        jnp.minimum(
+            jnp.min(d_ab, axis=(-2, -1)), jnp.min(d_ba, axis=(-2, -1))
+        ),
+        jnp.min(dv, axis=(-2, -1)),
+    )
     crossed = edges_intersect(ga, gb)
     return jnp.where(crossed, 0.0, jnp.sqrt(d2))
 
@@ -211,13 +226,24 @@ def points_min_dist(points: jax.Array, polys: DeviceGeometry) -> jax.Array:
 
 
 def intersects(ga: DeviceGeometry, gb: DeviceGeometry) -> jax.Array:
-    """(Ga, Gb) bool polygon/polygon intersects: edges cross, or a vertex of
-    one lies inside the other (covers containment)."""
+    """(Ga, Gb) bool polygon/polygon intersects: edges cross, or ANY vertex
+    of one lies inside the other (covers containment, incl. multi-part
+    geometries whose non-first part is the nested one)."""
     cross = edges_intersect(ga, gb)
-    # representative vertex containment both ways
-    va = ga.verts[:, 0, 0, :]  # (Ga,2) first vertex
-    vb = gb.verts[:, 0, 0, :]
-    a_in_b = contains_xy(va, gb)  # (Ga,Gb)
-    b_in_a = contains_xy(vb, ga).T  # (Ga,Gb)
-    nonempty = (ga.ring_len[:, 0] > 0)[:, None] & (gb.ring_len[:, 0] > 0)[None, :]
+    A, B = ga.verts.shape[0], gb.verts.shape[0]
+    va = ga.verts.reshape(A, -1, 2)
+    vam = ga.vert_mask.reshape(A, -1)
+    vb = gb.verts.reshape(B, -1, 2)
+    vbm = gb.vert_mask.reshape(B, -1)
+
+    def any_in(pts, pm, polys):
+        # (N,V,2),(N,V) vs polys (M,...) -> (N,M) any real vertex inside
+        def per(p, m):
+            return jnp.any(contains_xy(p, polys) & m[:, None], axis=0)
+
+        return jax.vmap(per)(pts, pm)
+
+    a_in_b = any_in(va, vam, gb)  # (Ga,Gb)
+    b_in_a = any_in(vb, vbm, ga).T  # (Ga,Gb)
+    nonempty = jnp.any(vam, axis=1)[:, None] & jnp.any(vbm, axis=1)[None, :]
     return (cross | a_in_b | b_in_a) & nonempty

@@ -1,0 +1,19 @@
+"""The flat function namespace — `from mosaic_tpu.functions import *` is the
+analog of `import mosaicContext.functions._` (reference:
+`functions/MosaicContext.scala:451-786`)."""
+
+from .aggregates import *  # noqa: F401,F403
+from .formats import *  # noqa: F401,F403
+from .geometry import *  # noqa: F401,F403
+from .grid import *  # noqa: F401,F403
+from .util import *  # noqa: F401,F403
+
+from . import aggregates, formats, geometry, grid, util
+
+__all__ = (
+    list(geometry.__all__)
+    + list(grid.__all__)
+    + list(formats.__all__)
+    + list(aggregates.__all__)
+    + list(util.__all__)
+)

@@ -1,0 +1,529 @@
+"""ST_ geometry functions — the DSL surface over the TPU compute core.
+
+Reference analog: the ~33 ST_ Catalyst expressions under
+`expressions/geometry/` plus their registration names
+(`functions/MosaicContext.scala:101-424`). Numeric measures and predicates
+dispatch to jitted device code (`core/geometry/measures.py`,
+`core/geometry/predicates.py`) or the float64 host oracle, selected by the
+``backend`` argument / active context; boolean ops, buffers and hulls run on
+the host C++ engine (`native/src/martinez.cpp`) per SURVEY.md §7.
+
+Geometry-returning functions serialize results back into the input's format
+(WKT in -> WKT out), matching `VectorExpression.serialise`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import crs as _crs
+from ..core.geometry import affine as _affine
+from ..core.geometry import hostops as _host
+from ..core.geometry import measures as _meas
+from ..core.geometry import oracle as _oracle
+from ..core.geometry import predicates as _pred
+from ..core.geometry.device import DeviceGeometry, pack_to_device
+from ..core.types import GeometryBuilder, GeometryType, PackedGeometry
+from ._coerce import coerce, like_input, to_packed
+
+__all__ = [
+    "st_area", "st_length", "st_perimeter", "st_centroid", "st_envelope",
+    "st_buffer", "st_bufferloop", "st_convexhull", "st_simplify",
+    "st_intersection", "st_union", "st_difference", "st_symdifference",
+    "st_unaryunion", "st_dump", "flatten_polygons", "st_contains",
+    "st_intersects", "st_distance", "st_geometrytype", "st_isvalid",
+    "st_numpoints", "st_x", "st_y", "st_xmin", "st_xmax", "st_ymin",
+    "st_ymax", "st_zmin", "st_zmax", "st_rotate", "st_scale", "st_translate",
+    "st_srid", "st_setsrid", "st_transform", "st_updatesrid",
+    "st_hasvalidcoordinates",
+]
+
+
+def _device_dtype():
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def _resolve_backend(backend: str | None) -> str:
+    if backend is not None:
+        return backend
+    from ..context import current_config
+
+    return current_config().geometry_backend
+
+
+def _dev(col: PackedGeometry) -> DeviceGeometry:
+    return pack_to_device(col, dtype=_device_dtype(), recenter=True)
+
+
+def _shift(dg: DeviceGeometry) -> np.ndarray:
+    return np.asarray(dg.shift, dtype=np.float64)
+
+
+# ----------------------------------------------------------------- measures
+
+
+def st_area(geom, backend: str | None = None) -> np.ndarray:
+    """Planar area per row (reference: ST_Area.scala:20-55)."""
+    col = to_packed(geom)
+    if _resolve_backend(backend) == "oracle":
+        return _oracle.area(col)
+    return np.asarray(_meas.area(_dev(col)), dtype=np.float64)
+
+
+def st_length(geom, backend: str | None = None) -> np.ndarray:
+    """Length / perimeter per row (reference: ST_Length == ST_Perimeter)."""
+    col = to_packed(geom)
+    if _resolve_backend(backend) == "oracle":
+        return _oracle.length(col)
+    return np.asarray(_meas.length(_dev(col)), dtype=np.float64)
+
+
+st_perimeter = st_length
+
+
+def st_centroid(geom, backend: str | None = None):
+    """Centroid as a POINT column, serialized like the input."""
+    col, fmt = coerce(geom)
+    if _resolve_backend(backend) == "oracle":
+        cxy = _oracle.centroid(col)
+    else:
+        dg = _dev(col)
+        cxy = np.asarray(_meas.centroid(dg), dtype=np.float64) + _shift(dg)
+    b = GeometryBuilder()
+    for g in range(len(col)):
+        b.add_geometry(GeometryType.POINT, [[cxy[g : g + 1]]], int(col.srid[g]))
+    return like_input(b.build(), fmt)
+
+
+def _bounds(col: PackedGeometry, backend: str | None) -> np.ndarray:
+    if _resolve_backend(backend) == "oracle":
+        return col.bounds()
+    dg = _dev(col)
+    s = _shift(dg)
+    return np.asarray(_meas.bounds(dg), dtype=np.float64) + np.concatenate([s, s])
+
+
+def st_xmin(geom, backend: str | None = None) -> np.ndarray:
+    return _bounds(to_packed(geom), backend)[:, 0]
+
+
+def st_ymin(geom, backend: str | None = None) -> np.ndarray:
+    return _bounds(to_packed(geom), backend)[:, 1]
+
+
+def st_xmax(geom, backend: str | None = None) -> np.ndarray:
+    return _bounds(to_packed(geom), backend)[:, 2]
+
+
+def st_ymax(geom, backend: str | None = None) -> np.ndarray:
+    return _bounds(to_packed(geom), backend)[:, 3]
+
+
+def _z_minmax(col: PackedGeometry, want_max: bool) -> np.ndarray:
+    out = np.full(len(col), np.nan)
+    if col.z is None:
+        return out
+    for g in range(len(col)):
+        if not col.has_z(g):
+            continue
+        sl = col.geom_vertex_slice(g)
+        zz = col.z[sl]
+        if zz.size:
+            out[g] = zz.max() if want_max else zz.min()
+    return out
+
+
+def st_zmin(geom) -> np.ndarray:
+    return _z_minmax(to_packed(geom), want_max=False)
+
+
+def st_zmax(geom) -> np.ndarray:
+    return _z_minmax(to_packed(geom), want_max=True)
+
+
+def st_envelope(geom):
+    """Bounding-box polygon per row (reference: ST_Envelope)."""
+    col, fmt = coerce(geom)
+    bb = col.bounds()
+    b = GeometryBuilder()
+    for g in range(len(col)):
+        x0, y0, x1, y1 = bb[g]
+        srid = int(col.srid[g])
+        if np.isnan(x0):
+            b.add_geometry(GeometryType.POLYGON, [[np.zeros((0, 2))]], srid)
+        else:
+            ring = np.array([[x0, y0], [x1, y0], [x1, y1], [x0, y1]])
+            b.add_geometry(GeometryType.POLYGON, [[ring]], srid)
+    return like_input(b.build(), fmt)
+
+
+def st_numpoints(geom) -> np.ndarray:
+    """Vertex count incl. polygon ring closing vertices (JTS getNumPoints)."""
+    col = to_packed(geom)
+    counts = col.vertices_per_geom().astype(np.int64)
+    rings = col.rings_per_geom()
+    poly = np.array(
+        [col.geometry_type(g).base == GeometryType.POLYGON for g in range(len(col))]
+    )
+    counts[poly] += rings[poly]
+    return counts
+
+
+def st_x(geom) -> np.ndarray:
+    """X of POINT rows (reference: ST_X)."""
+    return _point_coord(to_packed(geom), 0)
+
+
+def st_y(geom) -> np.ndarray:
+    return _point_coord(to_packed(geom), 1)
+
+
+def _point_coord(col: PackedGeometry, axis: int) -> np.ndarray:
+    out = np.full(len(col), np.nan)
+    for g in range(len(col)):
+        pts = col.geom_xy(g)
+        if pts.shape[0]:
+            out[g] = pts[0, axis]
+    return out
+
+
+def st_geometrytype(geom) -> list[str]:
+    """WKT type name per row (reference: ST_GeometryType)."""
+    col = to_packed(geom)
+    return [col.geometry_type(g).wkt_name for g in range(len(col))]
+
+
+def st_isvalid(geom) -> np.ndarray:
+    """Structural validity: finite coordinates, polygon rings with >= 3
+    vertices and nonzero area. (The reference delegates to JTS IsValidOp;
+    full OGC validity — ring self-intersection, nesting — is host-checked
+    only to this structural level in v1.)"""
+    col = to_packed(geom)
+    out = np.ones(len(col), dtype=bool)
+    from ..core.types import ring_signed_area
+
+    for g in range(len(col)):
+        xy = col.geom_xy(g)
+        if not np.isfinite(xy).all():
+            out[g] = False
+            continue
+        if col.geometry_type(g).base == GeometryType.POLYGON:
+            for p in col.geom_parts(g):
+                for r in col.part_rings(p):
+                    ring = col.ring_xy(r)
+                    if ring.shape[0] < 3 or ring_signed_area(ring) == 0.0:
+                        out[g] = False
+    return out
+
+
+# --------------------------------------------------------------- predicates
+
+_PAIR_AXES = DeviceGeometry(
+    verts=0, ring_len=0, ring_is_hole=0, n_rings=0, geom_type=0, shift=None
+)
+
+
+def _pair_pack(a: PackedGeometry, b: PackedGeometry):
+    """Pack two columns with one shared shift so coordinates line up."""
+    ba, bb = a.bounds(), b.bounds()
+    allb = np.concatenate([ba, bb], axis=0)
+    finite = allb[np.isfinite(allb[:, 0])]
+    if finite.size:
+        lo = finite[:, :2].min(axis=0)
+        hi = finite[:, 2:].max(axis=0)
+        shift = (lo + hi) / 2.0
+    else:
+        shift = np.zeros(2)
+    dt = _device_dtype()
+    da = pack_to_device(_affine.translate(a, -shift[0], -shift[1]), dtype=dt)
+    db = pack_to_device(_affine.translate(b, -shift[0], -shift[1]), dtype=dt)
+    return da, db
+
+
+def _vmap_pair(dense_fn, da: DeviceGeometry, db: DeviceGeometry):
+    def one(x, y):
+        x1 = jax.tree.map(lambda v: v[None], x)
+        y1 = jax.tree.map(lambda v: v[None], y)
+        return dense_fn(x1, y1)[0, 0]
+
+    return jax.vmap(one, in_axes=(_PAIR_AXES, _PAIR_AXES))(da, db)
+
+
+def _contains_dense(a: DeviceGeometry, b: DeviceGeometry) -> jax.Array:
+    """(Ga, Gb) b fully inside a: every real vertex of b inside a and no
+    boundary crossing. (Shared-boundary touching counts as not-contained,
+    slightly stricter than JTS `contains` on tangent rings.)"""
+    Gb = b.verts.shape[0]
+    pts = b.verts.reshape(Gb, -1, 2)
+    vm = b.vert_mask.reshape(Gb, -1)
+
+    def per_b(pts_b, vm_b):
+        inside = _pred.contains_xy(pts_b, a)  # (V*, Ga)
+        return jnp.all(inside | ~vm_b[:, None], axis=0) & jnp.any(vm_b)
+
+    in_a = jax.vmap(per_b)(pts, vm)  # (Gb, Ga)
+    cross = _pred.edges_intersect(a, b)  # (Ga, Gb)
+    return in_a.T & ~cross
+
+
+def st_contains(geom_a, geom_b, backend: str | None = None) -> np.ndarray:
+    """Row-wise a contains b (reference: ST_Contains / the PIP join
+    predicate, `core/geometry/MosaicGeometryJTS.scala:101`)."""
+    a, b = to_packed(geom_a), to_packed(geom_b)
+    if _resolve_backend(backend) == "oracle":
+        return _oracle_pair_contains(a, b)
+    da, db = _pair_pack(a, b)
+    return np.asarray(_vmap_pair(_contains_dense, da, db))
+
+
+def st_intersects(geom_a, geom_b, backend: str | None = None) -> np.ndarray:
+    """Row-wise intersects (reference: ST_Intersects)."""
+    a, b = to_packed(geom_a), to_packed(geom_b)
+    if _resolve_backend(backend) == "oracle":
+        return _oracle_pair_intersects(a, b)
+    da, db = _pair_pack(a, b)
+    return np.asarray(_vmap_pair(_pred.intersects, da, db))
+
+
+def _distance_dense(a: DeviceGeometry, b: DeviceGeometry) -> jax.Array:
+    d = _pred.min_distance(a, b)
+    cont = _contains_dense(a, b) | _contains_dense(b, a).T
+    return jnp.where(cont, 0.0, d)
+
+
+def st_distance(geom_a, geom_b, backend: str | None = None) -> np.ndarray:
+    """Row-wise euclidean distance, 0 when touching/overlapping/nested."""
+    a, b = to_packed(geom_a), to_packed(geom_b)
+    if _resolve_backend(backend) == "oracle":
+        return _oracle_pair_distance(a, b)
+    da, db = _pair_pack(a, b)
+    return np.asarray(_vmap_pair(_distance_dense, da, db), dtype=np.float64)
+
+
+# ------------------------------------------------------ host oracle (f64)
+
+
+def _rings_of(col: PackedGeometry, g: int) -> list[np.ndarray]:
+    return [
+        col.ring_xy(r) for p in col.geom_parts(g) for r in col.part_rings(p)
+    ]
+
+
+def _oracle_pair_contains(a, b) -> np.ndarray:
+    from ..core.tessellate import _even_odd_inside, _segments_cross
+
+    n = len(a)
+    out = np.zeros(n, dtype=bool)
+    for g in range(n):
+        ra, rb = _rings_of(a, g), _rings_of(b, g)
+        pts = b.geom_xy(g)
+        if not pts.shape[0] or not ra:
+            continue
+        if not _even_odd_inside(pts, ra).all():
+            continue
+        out[g] = not _rings_cross(ra, rb, a.geometry_type(g), b.geometry_type(g))
+    return out
+
+
+def _edges_of(rings: list[np.ndarray], closed: bool):
+    segs = []
+    for r in rings:
+        if r.shape[0] < 2:
+            continue
+        pts = np.vstack([r, r[:1]]) if closed else r
+        segs.append((pts[:-1], pts[1:]))
+    if not segs:
+        return np.zeros((0, 2)), np.zeros((0, 2))
+    return np.concatenate([s[0] for s in segs]), np.concatenate([s[1] for s in segs])
+
+
+def _rings_cross(ra, rb, ta: GeometryType, tb: GeometryType) -> bool:
+    from ..core.tessellate import _segments_cross
+
+    a0, a1 = _edges_of(ra, ta.base == GeometryType.POLYGON)
+    b0, b1 = _edges_of(rb, tb.base == GeometryType.POLYGON)
+    if not a0.shape[0] or not b0.shape[0]:
+        return False
+    return bool(np.any(_segments_cross(a0, a1, b0, b1)))
+
+
+def _oracle_pair_intersects(a, b) -> np.ndarray:
+    from ..core.tessellate import _even_odd_inside
+
+    n = len(a)
+    out = np.zeros(n, dtype=bool)
+    for g in range(n):
+        ra, rb = _rings_of(a, g), _rings_of(b, g)
+        pa, pb = a.geom_xy(g), b.geom_xy(g)
+        if not pa.shape[0] or not pb.shape[0]:
+            continue
+        if _rings_cross(ra, rb, a.geometry_type(g), b.geometry_type(g)):
+            out[g] = True
+            continue
+        # no boundary crossing: intersects iff ANY vertex of one lies inside
+        # the other (covers multi-part geometries with nested parts)
+        in_a = (
+            a.geometry_type(g).base == GeometryType.POLYGON
+            and bool(_even_odd_inside(pb, ra).any())
+        )
+        in_b = (
+            b.geometry_type(g).base == GeometryType.POLYGON
+            and bool(_even_odd_inside(pa, rb).any())
+        )
+        out[g] = bool(in_a or in_b)
+    return out
+
+
+def _oracle_pair_distance(a, b) -> np.ndarray:
+    n = len(a)
+    out = np.zeros(n)
+    inter = _oracle_pair_intersects(a, b)
+    for g in range(n):
+        if inter[g]:
+            continue
+        pa, pb = a.geom_xy(g), b.geom_xy(g)
+        da = min(
+            (_oracle.point_boundary_distance(b, g, p) for p in pa),
+            default=np.inf,
+        )
+        db = min(
+            (_oracle.point_boundary_distance(a, g, p) for p in pb),
+            default=np.inf,
+        )
+        out[g] = min(da, db)
+    return out
+
+
+# ----------------------------------------------- host C++ geometry engine
+
+
+def st_buffer(geom, distance: float, quad_segs: int = 8):
+    """Round-join buffer (reference: ST_Buffer -> JTS buffer)."""
+    col, fmt = coerce(geom)
+    return like_input(_host.buffer(col, float(distance), quad_segs), fmt)
+
+
+def st_bufferloop(geom, inner: float, outer: float):
+    """Ring between two buffer radii (reference: ST_BufferLoop)."""
+    col, fmt = coerce(geom)
+    ring = _host.difference(
+        _host.buffer(col, float(outer)), _host.buffer(col, float(inner))
+    )
+    return like_input(ring, fmt)
+
+
+def st_convexhull(geom):
+    col, fmt = coerce(geom)
+    return like_input(_host.convex_hull(col), fmt)
+
+
+def st_simplify(geom, tolerance: float):
+    col, fmt = coerce(geom)
+    return like_input(_host.simplify(col, float(tolerance)), fmt)
+
+
+def st_intersection(geom_a, geom_b):
+    """Row-wise boolean intersection (reference: ST_Intersection)."""
+    a, fmt = coerce(geom_a)
+    return like_input(_host.intersection(a, to_packed(geom_b)), fmt)
+
+
+def st_union(geom_a, geom_b):
+    a, fmt = coerce(geom_a)
+    return like_input(_host.union(a, to_packed(geom_b)), fmt)
+
+
+def st_difference(geom_a, geom_b):
+    a, fmt = coerce(geom_a)
+    return like_input(_host.difference(a, to_packed(geom_b)), fmt)
+
+
+def st_symdifference(geom_a, geom_b):
+    a, fmt = coerce(geom_a)
+    return like_input(_host.sym_difference(a, to_packed(geom_b)), fmt)
+
+
+def st_unaryunion(geom):
+    col, fmt = coerce(geom)
+    return like_input(_host.unary_union(col), fmt)
+
+
+def st_dump(geom):
+    """Explode multi-geometries into single parts (reference: ST_Dump /
+    FlattenPolygons). Returns (row_ids, parts serialized like input)."""
+    col, fmt = coerce(geom)
+    b = GeometryBuilder()
+    rows = []
+    for g in range(len(col)):
+        gt = col.geometry_type(g)
+        srid = int(col.srid[g])
+        for p in col.geom_parts(g):
+            rings = [col.ring_xy(r) for r in col.part_rings(p)]
+            b.add_geometry(gt.base, [rings], srid)
+            rows.append(g)
+    return np.asarray(rows, dtype=np.int64), like_input(b.build(), fmt)
+
+
+flatten_polygons = st_dump
+
+
+# ------------------------------------------------------------ affine / CRS
+
+
+def st_rotate(geom, theta):
+    col, fmt = coerce(geom)
+    return like_input(_affine.rotate(col, theta), fmt)
+
+
+def st_scale(geom, sx, sy):
+    col, fmt = coerce(geom)
+    return like_input(_affine.scale(col, sx, sy), fmt)
+
+
+def st_translate(geom, dx, dy):
+    col, fmt = coerce(geom)
+    return like_input(_affine.translate(col, dx, dy), fmt)
+
+
+def st_srid(geom) -> np.ndarray:
+    return to_packed(geom).srid.copy()
+
+
+def st_setsrid(geom, srid: int):
+    col, fmt = coerce(geom)
+    return like_input(_affine.set_srid(col, int(srid)), fmt)
+
+
+def st_transform(geom, to_srid: int):
+    """Reproject to ``to_srid`` (reference: ST_Transform via proj4j)."""
+    col, fmt = coerce(geom)
+    return like_input(_affine.transform_srid(col, int(to_srid)), fmt)
+
+
+def st_updatesrid(geom, from_srid: int, to_srid: int):
+    """Relabel then reproject (reference: ST_UpdateSRID)."""
+    col, fmt = coerce(geom)
+    col = _affine.set_srid(col, int(from_srid))
+    return like_input(_affine.transform_srid(col, int(to_srid)), fmt)
+
+
+def st_hasvalidcoordinates(geom, crs_code, which: str = "bounds") -> np.ndarray:
+    """All vertices inside the CRS validity envelope (reference:
+    ST_HasValidCoordinates + CRSBoundsProvider, `core/crs/`)."""
+    col = to_packed(geom)
+    srid = _crs.parse_crs_code(crs_code)
+    x0, y0, x1, y1 = _crs.crs_bounds(srid, reprojected=(which != "bounds"))
+    out = np.zeros(len(col), dtype=bool)
+    for g in range(len(col)):
+        xy = col.geom_xy(g)
+        if not xy.shape[0]:
+            continue
+        out[g] = bool(
+            (xy[:, 0] >= x0).all()
+            and (xy[:, 0] <= x1).all()
+            and (xy[:, 1] >= y0).all()
+            and (xy[:, 1] <= y1).all()
+        )
+    return out

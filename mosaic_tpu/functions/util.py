@@ -1,0 +1,34 @@
+"""Utility functions: error-wrapped application (try_sql analog).
+
+Reference analog: the `TrySql` expression (`expressions/util/TrySql.scala:
+12-71`, registered at `functions/MosaicContext.scala:412-416`) which converts
+per-row evaluation errors into null results plus an error column.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+__all__ = ["try_sql"]
+
+
+def try_sql(fn: Callable, *columns, **kwargs):
+    """Apply ``fn`` row-by-row; failures become None + an error message.
+
+    Returns ``(results: list, errors: list[str | None])``. The reference
+    wraps one expression per query; here any row-wise callable works:
+
+    >>> res, err = try_sql(lambda w: st_area([w])[0], wkts)
+    """
+    n = len(columns[0])
+    results: list = [None] * n
+    errors: list = [None] * n
+    for i in range(n):
+        args = [c[i] for c in columns]
+        try:
+            results[i] = fn(*args, **kwargs)
+        except Exception as e:  # noqa: BLE001 — per-row isolation is the point
+            errors[i] = f"{type(e).__name__}: {e}"
+    return results, errors

@@ -55,6 +55,42 @@ static double contourArea(const Contour& c) {
   return 0.5 * a;
 }
 
+// Snap-round every coordinate onto one shared power-of-two lattice
+// (~2^-40 of the coordinate magnitude, ~1e-12 relative). Rationale: chips
+// produced by independent clipping passes share edges whose endpoints differ
+// in the last few ulps; the sweep line handles *bit-identical* overlapping
+// segments robustly but mis-resolves almost-coincident ones. A power-of-two
+// quantum makes the snap exact in binary floating point.
+static void snapLattice(std::vector<std::vector<Contour>*> groups) {
+  double m = 0;
+  for (auto* cs : groups)
+    for (auto& c : *cs)
+      for (auto& p : c) {
+        m = std::max(m, std::abs(p.x));
+        m = std::max(m, std::abs(p.y));
+      }
+  if (!(m > 0) || !std::isfinite(m)) return;
+  double q = std::ldexp(1.0, (int)std::floor(std::log2(m)) - 40);
+  for (auto* cs : groups) {
+    for (auto& c : *cs)
+      for (auto& p : c) {
+        p.x = std::round(p.x / q) * q;
+        p.y = std::round(p.y / q) * q;
+      }
+    // snapping can merge consecutive vertices; drop dups + degenerates
+    for (auto& c : *cs) {
+      Contour d;
+      for (auto& p : c)
+        if (d.empty() || !(d.back() == p)) d.push_back(p);
+      if (d.size() >= 2 && d.front() == d.back()) d.pop_back();
+      c.swap(d);
+    }
+    cs->erase(std::remove_if(cs->begin(), cs->end(),
+                             [](const Contour& c) { return c.size() < 3; }),
+              cs->end());
+  }
+}
+
 static void dropSlivers(std::vector<Contour>& cs, double eps) {
   cs.erase(std::remove_if(cs.begin(), cs.end(),
                           [&](const Contour& c) {
@@ -160,6 +196,7 @@ int mg_bool_op(int op, const double* axy, const int64_t* aro, int64_t anr,
                int64_t* out_nr) {
   auto a = mg::toContours(axy, aro, anr);
   auto b = mg::toContours(bxy, bro, bnr);
+  mg::snapLattice({&a, &b});
   std::vector<mg::Contour> out;
   mg::boolOp((mg::BoolOp)op, a, b, out);
   mg::dropSlivers(out, 0.0);
@@ -208,6 +245,11 @@ int mg_union_many(const double* xy, const int64_t* ro, int64_t nr,
       }
       if (!item.empty()) items.push_back(std::move(item));
     }
+  }
+  {
+    std::vector<std::vector<mg::Contour>*> ptrs;
+    for (auto& it : items) ptrs.push_back(&it);
+    mg::snapLattice(ptrs);
   }
   auto out = mg::unionMany(std::move(items));
   mg::dropSlivers(out, 0.0);

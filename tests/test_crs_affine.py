@@ -1,0 +1,131 @@
+"""CRS transforms + affine ops.
+
+Anchors: the OS Guide transverse-Mercator worked example (OSGB36 lat/lon ->
+BNG easting/northing), the Web Mercator closed form, and round-trips for
+every supported SRID in both the numpy and the jitted jax path.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mosaic_tpu.core import crs
+from mosaic_tpu.core.geometry import affine
+from mosaic_tpu.core.geometry.wkt import from_wkt, to_wkt
+
+
+# OS Guide worked example: OSGB36 lat 52°39'27.2531"N, lon 1°43'4.5177"E
+_OS_LAT = 52 + 39 / 60 + 27.2531 / 3600
+_OS_LON = 1 + 43 / 60 + 4.5177 / 3600
+_OS_E, _OS_N = 651409.903, 313177.270
+
+
+def test_tm_forward_os_anchor():
+    ll = np.radians(np.array([[_OS_LON, _OS_LAT]]))
+    en = crs.tm_forward(crs.BNG_TM, ll)
+    assert abs(en[0, 0] - _OS_E) < 2e-3
+    assert abs(en[0, 1] - _OS_N) < 2e-3
+
+
+def test_tm_inverse_os_anchor():
+    ll = crs.tm_inverse(crs.BNG_TM, np.array([[_OS_E, _OS_N]]))
+    deg = np.degrees(ll)
+    assert abs(deg[0, 0] - _OS_LON) < 1e-8
+    assert abs(deg[0, 1] - _OS_LAT) < 1e-8
+
+
+def test_webmercator_closed_form():
+    pts = np.array([[45.0, 0.0], [-180.0, 0.0], [0.0, 45.0]])
+    out = crs.from_wgs84(pts, 3857)
+    assert abs(out[0, 0] - crs.WGS84_A * math.pi / 4) < 1e-6
+    assert abs(out[1, 0] + 20037508.342789244) < 1e-6
+    back = crs.to_wgs84(out, 3857)
+    np.testing.assert_allclose(back, pts, atol=1e-9)
+
+
+@pytest.mark.parametrize("srid", [3857, 27700, 32630, 32733])
+def test_roundtrip_numpy(srid):
+    rng = np.random.default_rng(srid)
+    if srid == 27700:
+        lon = rng.uniform(-5, 1.5, 64)
+        lat = rng.uniform(50, 58, 64)
+    elif srid == 32630:
+        lon = rng.uniform(-6, 0, 64)
+        lat = rng.uniform(1, 60, 64)
+    elif srid == 32733:
+        lon = rng.uniform(12, 18, 64)
+        lat = rng.uniform(-60, -1, 64)
+    else:
+        lon = rng.uniform(-179, 179, 64)
+        lat = rng.uniform(-84, 84, 64)
+    pts = np.stack([lon, lat], axis=-1)
+    # 2e-7 deg ~ 2 cm: the Helmert inverse (negated params) is approximate
+    back = crs.to_wgs84(crs.from_wgs84(pts, srid), srid)
+    np.testing.assert_allclose(back, pts, atol=2e-7)
+
+
+def test_transform_jax_matches_numpy():
+    pts = np.array([[-0.1195, 51.5033], [-2.0, 53.0], [0.5, 52.0]])
+    host = crs.from_wgs84(pts, 27700)
+
+    @jax.jit
+    def f(x):
+        return crs.from_wgs84(x, 27700, xp=jnp)
+
+    dev = np.asarray(f(jnp.asarray(pts, dtype=jnp.float64)))
+    np.testing.assert_allclose(dev, host, atol=1e-5)
+
+
+def test_bng_known_point_tolerance():
+    # London Eye, WGS84 -> BNG grid ref TQ 30620 79940 (±20 m: single
+    # 7-parameter Helmert, like proj4j's +towgs84 path, not OSTN15)
+    out = crs.from_wgs84(np.array([[-0.119543, 51.503324]]), 27700)
+    assert abs(out[0, 0] - 530620) < 20
+    assert abs(out[0, 1] - 179940) < 20
+
+
+def test_crs_bounds_lookup():
+    geo = crs.crs_bounds(27700, reprojected=False)
+    proj = crs.crs_bounds(27700, reprojected=True)
+    assert geo[0] < -8 and proj[2] > 600000
+    assert crs.parse_crs_code("EPSG:27700") == 27700
+    assert crs.parse_crs_code(4326) == 4326
+
+
+# ----------------------------------------------------------------- affine
+
+
+def test_translate_scale_rotate():
+    col = from_wkt(["POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))", "POINT (1 1)"])
+    t = affine.translate(col, 10, 20)
+    assert to_wkt(t.take([1]))[0] == "POINT (11 21)"
+    s = affine.scale(col, 2, 3)
+    np.testing.assert_allclose(s.geom_xy(1), [[2.0, 3.0]])
+    r = affine.rotate(col, math.pi / 2)
+    np.testing.assert_allclose(r.geom_xy(1), [[-1.0, 1.0]], atol=1e-12)
+
+
+def test_per_geometry_params():
+    col = from_wkt(["POINT (1 0)", "POINT (1 0)"])
+    r = affine.rotate(col, np.array([0.0, math.pi]))
+    np.testing.assert_allclose(r.geom_xy(0), [[1.0, 0.0]], atol=1e-12)
+    np.testing.assert_allclose(r.geom_xy(1), [[-1.0, 0.0]], atol=1e-12)
+
+
+def test_transform_srid_roundtrip():
+    col = from_wkt(["POINT (-0.5 51.6)", "LINESTRING (-1 52, -0.9 52.1)"])
+    bng = affine.transform_srid(col, 27700)
+    assert set(bng.srid.tolist()) == {27700}
+    assert bng.geom_xy(0)[0, 0] > 100000  # easting, not degrees
+    back = affine.transform_srid(bng, 4326)
+    np.testing.assert_allclose(back.xy, col.xy, atol=1e-7)
+
+
+def test_set_srid_labels_only():
+    col = from_wkt(["POINT (1 2)"])
+    out = affine.set_srid(col, 27700)
+    assert out.srid[0] == 27700
+    np.testing.assert_array_equal(out.xy, col.xy)
