@@ -61,6 +61,18 @@ class MosaicConfig:
     cell_id_type: str = "long"  # 'long' | 'string'
     raster_checkpoint: str = "/tmp/mosaic_tpu/raster_checkpoint"
 
+    def __post_init__(self):
+        if self.geometry_backend not in ("device", "oracle"):
+            raise ValueError(
+                f"geometry_backend must be 'device' or 'oracle', got "
+                f"{self.geometry_backend!r}"
+            )
+        if self.cell_id_type not in ("long", "string"):
+            raise ValueError(
+                f"cell_id_type must be 'long' or 'string', got "
+                f"{self.cell_id_type!r}"
+            )
+
 
 class MosaicContext:
     """Process-wide context (reference: MosaicContext singleton :792-818)."""

@@ -29,6 +29,9 @@ class IndexSystem(abc.ABC):
     #: number of vertices of a cell boundary polygon (4 for squares, up to 10
     #: for H3 cells with distortion vertices; boundaries are padded to this).
     boundary_max_verts: int = 4
+    #: CRS the grid's coordinates live in (0 = abstract/unknown). H3 is
+    #: WGS84 lon/lat; BNG is EPSG:27700 eastings/northings.
+    crs_srid: int = 4326
 
     # ------------------------------------------------------------- metadata
     @abc.abstractmethod

@@ -74,6 +74,7 @@ def _k_digits(res: int) -> int:
 
 class BNGIndexSystem(IndexSystem):
     name = "BNG"
+    crs_srid = 27700
     boundary_max_verts = 5  # closed square
 
     def resolutions(self) -> Sequence[int]:

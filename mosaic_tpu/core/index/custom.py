@@ -60,6 +60,7 @@ class GridConf:
 
 class CustomIndexSystem(IndexSystem):
     boundary_max_verts = 5
+    crs_srid = 0  # abstract grid: caller-defined CRS, no implicit transform
 
     def __init__(self, conf: GridConf):
         self.conf = conf

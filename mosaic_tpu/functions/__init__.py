@@ -6,14 +6,16 @@ from .aggregates import *  # noqa: F401,F403
 from .formats import *  # noqa: F401,F403
 from .geometry import *  # noqa: F401,F403
 from .grid import *  # noqa: F401,F403
+from .raster import *  # noqa: F401,F403
 from .util import *  # noqa: F401,F403
 
-from . import aggregates, formats, geometry, grid, util
+from . import aggregates, formats, geometry, grid, raster, util
 
 __all__ = (
     list(geometry.__all__)
     + list(grid.__all__)
     + list(formats.__all__)
     + list(aggregates.__all__)
+    + list(raster.__all__)
     + list(util.__all__)
 )

@@ -11,8 +11,6 @@ through :func:`like_input`.
 
 from __future__ import annotations
 
-from typing import Sequence
-
 import numpy as np
 
 from ..core.types import PackedGeometry
@@ -27,6 +25,8 @@ def detect_format(data) -> str:
     """Best-effort input form detection ('packed'|'wkt'|'wkb'|'hex'|'geojson')."""
     if isinstance(data, PackedGeometry):
         return "packed"
+    if isinstance(data, np.ndarray):
+        data = data.tolist()
     item = data
     if isinstance(data, (list, tuple)) and len(data):
         item = data[0]
@@ -49,6 +49,8 @@ def detect_format(data) -> str:
 
 def coerce(data, srid: int = 4326) -> tuple[PackedGeometry, str]:
     """Any geometry input -> (PackedGeometry, detected format)."""
+    if isinstance(data, np.ndarray):
+        data = data.tolist()
     fmt = detect_format(data)
     if fmt == "packed":
         return data, fmt

@@ -14,8 +14,7 @@ import numpy as np
 
 from ..core.geometry import hostops as _host
 from ..core.index.base import IndexSystem
-from ..core.tessellate import ChipTable
-from ..core.types import GeometryBuilder, GeometryType, PackedGeometry
+from ..core.types import GeometryBuilder, PackedGeometry
 from ._coerce import to_packed
 
 __all__ = [
@@ -67,19 +66,18 @@ def _chip_pair_geoms(
     else:
         rows, inter = np.zeros(0, np.int64), None
     inter_pos = {int(r): i for i, r in enumerate(rows)}
-    cell_cache: dict[int, PackedGeometry] = {}
+    # one batched boundary call for all distinct core∩core cells
+    # (grid_boundary also drops the padded repeats of the final boundary
+    # vertex — duplicate vertices break the sweep line)
+    cc = np.unique(cells[a_core & b_core])
+    if cc.size:
+        from .grid import grid_boundary
+
+        cc_geoms = grid_boundary(cc, fmt="packed", index=index)
+        cell_pos = {int(c): i for i, c in enumerate(cc)}
     for i in range(n):
         if a_core[i] and b_core[i]:
-            cid = int(cells[i])
-            if cid not in cell_cache:
-                from .grid import grid_boundary
-
-                # grid_boundary drops the padded repeats of the final
-                # boundary vertex (duplicate vertices break the sweep line)
-                cell_cache[cid] = grid_boundary(
-                    np.asarray([cid]), fmt="packed", index=index
-                )
-            out.append_from(cell_cache[cid], 0)
+            out.append_from(cc_geoms, cell_pos[int(cells[i])])
         elif a_core[i]:
             out.append_from(b_chips, i)
         elif b_core[i]:

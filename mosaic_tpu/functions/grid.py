@@ -90,7 +90,7 @@ def grid_boundary(cells, fmt: str = "wkt", index: IndexSystem | None = None):
                 keep[j] = False
             else:
                 break
-        b.add_geometry(GeometryType.POLYGON, [[ring[keep]]], 4326)
+        b.add_geometry(GeometryType.POLYGON, [[ring[keep]]], idx.crs_srid)
     return serialize(b.build(), fmt)
 
 

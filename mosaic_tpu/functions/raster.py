@@ -1,0 +1,287 @@
+"""RST_ raster functions.
+
+Reference analog: the 32 raster expressions under `expressions/raster/`
+(metadata + georeference accessors, world<->raster coordinate transforms,
+`RST_ReTile` generator, and the five `RST_RasterToGrid{Avg,Min,Max,Median,
+Count}` projections whose per-pixel JVM callback loop
+(`expressions/raster/base/RasterToGridExpression.scala:55-92`) becomes one
+fused device program here: affine pixel->world, `point_to_cell`, and
+`jax.ops.segment_*` reductions).
+
+Raster columns are lists of :class:`~mosaic_tpu.raster.Raster` (or paths,
+coerced via `read_raster`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.index.base import IndexSystem
+from ..raster import Raster, read_raster
+
+__all__ = [
+    "rst_metadata", "rst_bandmetadata", "rst_georeference", "rst_height",
+    "rst_width", "rst_numbands", "rst_srid", "rst_memsize", "rst_isempty",
+    "rst_subdatasets", "rst_summary", "rst_scalex", "rst_scaley",
+    "rst_skewx", "rst_skewy", "rst_upperleftx", "rst_upperlefty",
+    "rst_pixelwidth", "rst_pixelheight", "rst_rotation",
+    "rst_rastertoworldcoord", "rst_rastertoworldcoordx",
+    "rst_rastertoworldcoordy", "rst_worldtorastercoord",
+    "rst_worldtorastercoordx", "rst_worldtorastercoordy", "rst_retile",
+    "rst_rastertogridavg", "rst_rastertogridmin", "rst_rastertogridmax",
+    "rst_rastertogridmedian", "rst_rastertogridcount",
+]
+
+
+def _rasters(col) -> list[Raster]:
+    if isinstance(col, Raster):
+        return [col]
+    if isinstance(col, (str,)):
+        return [read_raster(col)]
+    return [r if isinstance(r, Raster) else read_raster(r) for r in col]
+
+
+# ------------------------------------------------------------- metadata
+
+
+def rst_metadata(col) -> list[dict]:
+    return [r.metadata() for r in _rasters(col)]
+
+
+def rst_bandmetadata(col, band: int) -> list[dict]:
+    return [r.band_metadata(band) for r in _rasters(col)]
+
+
+def rst_georeference(col) -> list[dict]:
+    return [r.georeference() for r in _rasters(col)]
+
+
+def rst_height(col) -> np.ndarray:
+    return np.array([r.height for r in _rasters(col)], dtype=np.int64)
+
+
+def rst_width(col) -> np.ndarray:
+    return np.array([r.width for r in _rasters(col)], dtype=np.int64)
+
+
+def rst_numbands(col) -> np.ndarray:
+    return np.array([r.num_bands for r in _rasters(col)], dtype=np.int64)
+
+
+def rst_srid(col) -> np.ndarray:
+    return np.array([r.srid for r in _rasters(col)], dtype=np.int64)
+
+
+def rst_memsize(col) -> np.ndarray:
+    return np.array([r.memsize for r in _rasters(col)], dtype=np.int64)
+
+
+def rst_isempty(col) -> np.ndarray:
+    return np.array([r.is_empty() for r in _rasters(col)], dtype=bool)
+
+
+def rst_subdatasets(col) -> list[dict]:
+    return [r.subdatasets() for r in _rasters(col)]
+
+
+def rst_summary(col) -> list[dict]:
+    return [r.summary() for r in _rasters(col)]
+
+
+def _gt(col, i: int) -> np.ndarray:
+    return np.array([r.gt[i] for r in _rasters(col)], dtype=np.float64)
+
+
+def rst_upperleftx(col) -> np.ndarray:
+    return _gt(col, 0)
+
+
+def rst_scalex(col) -> np.ndarray:
+    return _gt(col, 1)
+
+
+def rst_skewx(col) -> np.ndarray:
+    return _gt(col, 2)
+
+
+def rst_upperlefty(col) -> np.ndarray:
+    return _gt(col, 3)
+
+
+def rst_skewy(col) -> np.ndarray:
+    return _gt(col, 4)
+
+
+def rst_scaley(col) -> np.ndarray:
+    return _gt(col, 5)
+
+
+def rst_pixelwidth(col) -> np.ndarray:
+    """Ground width of a pixel incl. skew (reference: RST_PixelWidth)."""
+    return np.hypot(_gt(col, 1), _gt(col, 4))
+
+
+def rst_pixelheight(col) -> np.ndarray:
+    return np.hypot(_gt(col, 5), _gt(col, 2))
+
+
+def rst_rotation(col) -> np.ndarray:
+    """Rotation angle (radians) of the raster grid vs north-up
+    (reference: RST_Rotation)."""
+    return np.arctan2(_gt(col, 4), _gt(col, 1))
+
+
+# --------------------------------------------------- coordinate transforms
+
+
+def rst_rastertoworldcoord(col, x, y) -> np.ndarray:
+    """(N, 2) world coords of pixel (x, y) per raster."""
+    out = [r.raster_to_world(x, y) for r in _rasters(col)]
+    return np.array(out, dtype=np.float64)
+
+
+def rst_rastertoworldcoordx(col, x, y) -> np.ndarray:
+    return rst_rastertoworldcoord(col, x, y)[:, 0]
+
+
+def rst_rastertoworldcoordy(col, x, y) -> np.ndarray:
+    return rst_rastertoworldcoord(col, x, y)[:, 1]
+
+
+def rst_worldtorastercoord(col, x, y) -> np.ndarray:
+    """(N, 2) int pixel coords of world point (x, y) per raster."""
+    out = []
+    for r in _rasters(col):
+        c, rr = r.world_to_raster(x, y)
+        out.append((int(np.floor(c)), int(np.floor(rr))))
+    return np.array(out, dtype=np.int64)
+
+
+def rst_worldtorastercoordx(col, x, y) -> np.ndarray:
+    return rst_worldtorastercoord(col, x, y)[:, 0]
+
+
+def rst_worldtorastercoordy(col, x, y) -> np.ndarray:
+    return rst_worldtorastercoord(col, x, y)[:, 1]
+
+
+# ----------------------------------------------------------------- retile
+
+
+def rst_retile(col, tile_width: int, tile_height: int) -> list[Raster]:
+    """Explode rasters into tiles (reference: RST_ReTile generator)."""
+    out: list[Raster] = []
+    for r in _rasters(col):
+        out.extend(r.retile(tile_width, tile_height))
+    return out
+
+
+# --------------------------------------------------------- raster -> grid
+
+
+def _pixel_cells(
+    r: Raster, resolution: int, index: IndexSystem, raster_srid: "int | None"
+) -> np.ndarray:
+    """Cell id of every pixel center — the device half of the projection."""
+    import jax.numpy as jnp
+
+    from ..core import crs as _crs
+
+    x, y = r.pixel_centers()
+    srid = raster_srid if raster_srid is not None else (r.srid or 4326)
+    xy = np.stack([x, y], axis=-1)
+    target = getattr(index, "crs_srid", 4326)
+    if target and srid != target and _crs.supported(srid):
+        xy = _crs.transform_points(xy, srid, target)
+    return np.asarray(
+        index.point_to_cell(jnp.asarray(xy), resolution), dtype=np.int64
+    )
+
+
+def _raster_to_grid(col, resolution, index, combiner: str, raster_srid=None):
+    """Shared pixel->cell group-combine (reference:
+    `RasterToGridExpression.rasterTransform:55-72`): returns per raster a
+    list (per band) of dicts cell_id -> combined value.
+
+    avg/count ride `jax.ops.segment_sum` on device; min/max use
+    `segment_min/max`; median sorts on host (no fixed-size device reduction).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if index is None:
+        from ..context import current_context
+
+        index = current_context().index_system
+    resolution = index.resolution_arg(resolution)
+    results = []
+    for r in _rasters(col):
+        cells = _pixel_cells(r, resolution, index, raster_srid)
+        uniq, inv = np.unique(cells, return_inverse=True)
+        inv_j = jnp.asarray(inv)
+        nseg = int(uniq.size)
+        per_band = []
+        for b in r.bands:
+            vals = b.values.ravel().astype(np.float64)
+            mask = b.mask.ravel()
+            v = jnp.asarray(np.where(mask, vals, 0.0))
+            m = jnp.asarray(mask.astype(np.float64))
+            if combiner in ("avg", "count"):
+                cnt = jax.ops.segment_sum(m, inv_j, num_segments=nseg)
+                if combiner == "count":
+                    out = np.asarray(cnt)
+                else:
+                    s = jax.ops.segment_sum(v * m, inv_j, num_segments=nseg)
+                    out = np.asarray(s) / np.maximum(np.asarray(cnt), 1.0)
+            elif combiner == "min":
+                big = jnp.where(m > 0, v, jnp.inf)
+                out = np.asarray(
+                    jax.ops.segment_min(big, inv_j, num_segments=nseg)
+                )
+            elif combiner == "max":
+                small = jnp.where(m > 0, v, -jnp.inf)
+                out = np.asarray(
+                    jax.ops.segment_max(small, inv_j, num_segments=nseg)
+                )
+            elif combiner == "median":
+                out = np.full(nseg, np.nan)
+                order = np.argsort(inv, kind="stable")
+                sorted_vals = vals[order]
+                sorted_mask = mask[order]
+                bounds = np.searchsorted(inv[order], np.arange(nseg + 1))
+                for s in range(nseg):
+                    seg = sorted_vals[bounds[s] : bounds[s + 1]]
+                    msk = sorted_mask[bounds[s] : bounds[s + 1]]
+                    seg = seg[msk]
+                    out[s] = np.median(seg) if seg.size else np.nan
+            else:
+                raise ValueError(f"unknown combiner {combiner!r}")
+            valid_cnt = np.asarray(
+                jax.ops.segment_sum(m, inv_j, num_segments=nseg)
+            )
+            keep = valid_cnt > 0
+            per_band.append(
+                {int(c): float(o) for c, o, k in zip(uniq, out, keep) if k}
+            )
+        results.append(per_band)
+    return results
+
+
+def rst_rastertogridavg(col, resolution, index=None, raster_srid=None):
+    return _raster_to_grid(col, resolution, index, "avg", raster_srid)
+
+
+def rst_rastertogridmin(col, resolution, index=None, raster_srid=None):
+    return _raster_to_grid(col, resolution, index, "min", raster_srid)
+
+
+def rst_rastertogridmax(col, resolution, index=None, raster_srid=None):
+    return _raster_to_grid(col, resolution, index, "max", raster_srid)
+
+
+def rst_rastertogridmedian(col, resolution, index=None, raster_srid=None):
+    return _raster_to_grid(col, resolution, index, "median", raster_srid)
+
+
+def rst_rastertogridcount(col, resolution, index=None, raster_srid=None):
+    return _raster_to_grid(col, resolution, index, "count", raster_srid)

@@ -1,0 +1,13 @@
+"""Raster subsystem: dataset/band model over the native GeoTIFF engine.
+
+Reference analog: the GDAL-backed raster core
+(`core/raster/MosaicRasterGDAL.scala:17-254`, `MosaicRasterBandGDAL.scala:
+10-160`) and the RasterAPI plugin seam (`core/raster/api/RasterAPI.scala:11`).
+The TPU-native design keeps pixels as numpy/JAX arrays in band-sequential
+layout so raster->grid projections run as fused device programs instead of
+per-pixel JVM callbacks.
+"""
+
+from .core import Raster, RasterBand, read_raster, write_geotiff  # noqa: F401
+
+__all__ = ["Raster", "RasterBand", "read_raster", "write_geotiff"]

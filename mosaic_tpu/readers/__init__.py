@@ -1,0 +1,22 @@
+"""Datasource readers (the reference's FileFormat/multiread layer).
+
+Reference analogs: `datasource/OGRFileFormat.scala` (vector files ->
+DataFrame), `datasource/GDALFileFormat.scala` (raster metadata datasource),
+`datasource/multiread/OGRMultiReadDataFrameReader.scala` (parallel chunked
+vector reads), `datasource/multiread/RasterAsGridReader.scala` (the full
+raster->grid pipeline). `read(fmt)` mirrors `MosaicContext.read.format(...)`
+(`functions/MosaicContext.scala:802`).
+"""
+
+from .registry import read  # noqa: F401
+from .vector import read_geojson, read_shapefile, read_points_csv  # noqa: F401
+from .raster_grid import raster_to_grid, read_gdal_metadata  # noqa: F401
+
+__all__ = [
+    "read",
+    "read_geojson",
+    "read_shapefile",
+    "read_points_csv",
+    "raster_to_grid",
+    "read_gdal_metadata",
+]

@@ -1,0 +1,91 @@
+"""`read(fmt)` — the `MosaicContext.read.format(...)` analog.
+
+Reference: `MosaicDataFrameReader` dispatching on format name
+(`datasource/multiread/MosaicDataFrameReader.scala`), service-loader
+registration of the six datasources (META-INF DataSourceRegister).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class _Reader:
+    def __init__(self, fmt: str):
+        self.fmt = fmt
+        self.options: dict = {}
+
+    def option(self, key: str, value) -> "_Reader":
+        self.options[key] = value
+        return self
+
+    def load(self, path, **kwargs):
+        merged = {**self.options, **kwargs}
+        return _FORMATS[self.fmt](path, **merged)
+
+
+def _fmt_shapefile(path, **kw):
+    from .vector import read_shapefile
+
+    return read_shapefile(path)
+
+
+def _fmt_geojson(path, **kw):
+    from .vector import read_geojson
+
+    return read_geojson(path)
+
+
+def _fmt_multiread(path, **kw):
+    from .vector import multiread
+
+    return multiread(path, chunk_size=int(kw.get("chunkSize", 5000)))
+
+
+def _fmt_gdal(path, **kw):
+    from .raster_grid import read_gdal_metadata
+
+    return read_gdal_metadata(path, ext=kw.get("extensions", ".TIF"))
+
+
+def _fmt_raster_to_grid(path, **kw):
+    from .raster_grid import raster_to_grid
+
+    return raster_to_grid(
+        path,
+        resolution=int(kw.get("resolution", 0)),
+        combiner=kw.get("combiner", "avg"),
+        index=kw.get("index"),
+        raster_srid=kw.get("rasterSrid"),
+        tile_size=int(kw.get("retileSize", 512)),
+        k_ring_interpolate=int(kw.get("kRingInterpolate", 0)),
+        ext=kw.get("extensions", ".TIF"),
+    )
+
+
+def _fmt_csv_points(path, **kw):
+    from .vector import read_points_csv
+
+    return read_points_csv(
+        path,
+        lon_col=kw.get("lonCol", "pickup_longitude"),
+        lat_col=kw.get("latCol", "pickup_latitude"),
+        max_rows=kw.get("maxRows"),
+    )
+
+
+_FORMATS: dict[str, Callable] = {
+    "shapefile": _fmt_shapefile,
+    "geojson": _fmt_geojson,
+    "multi_read_ogr": _fmt_multiread,
+    "gdal": _fmt_gdal,
+    "raster_to_grid": _fmt_raster_to_grid,
+    "csv_points": _fmt_csv_points,
+}
+
+
+def read(fmt: str) -> _Reader:
+    """`read("raster_to_grid").option("resolution", 6).load(path)`."""
+    if fmt not in _FORMATS:
+        raise ValueError(f"unknown format {fmt!r}; have {sorted(_FORMATS)}")
+    return _Reader(fmt)

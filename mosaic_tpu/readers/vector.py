@@ -1,0 +1,322 @@
+"""Vector file readers: Shapefile (.shp/.dbf), GeoJSON, CSV points.
+
+Reference analog: `datasource/OGRFileFormat.scala:26-473` (any OGR driver ->
+rows with WKB + attribute columns, schema inferred by scanning features) and
+the pinned-driver subclasses (`ShapefileFileFormat.scala:11-47`). Without
+GDAL, the two formats the reference's test-suite exercises most — ESRI
+Shapefile and GeoJSON — are decoded natively here; both produce a
+:class:`VectorTable` (PackedGeometry column + numpy attribute columns), the
+columnar analog of the OGR feature rows.
+
+The ESRI shapefile main/dBASE formats are public specs; this decoder is
+written to the spec, not to any other implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from pathlib import Path
+
+import numpy as np
+
+from ..core.geometry.geojson import read_feature_collection
+from ..core.types import GeometryBuilder, GeometryType, PackedGeometry
+
+
+@dataclasses.dataclass
+class VectorTable:
+    """Geometry column + attribute columns (the OGR feature table analog)."""
+
+    geometry: PackedGeometry
+    columns: dict[str, np.ndarray]
+
+    def __len__(self) -> int:
+        return len(self.geometry)
+
+    def slice(self, start: int, stop: int) -> "VectorTable":
+        idx = list(range(start, min(stop, len(self))))
+        return VectorTable(
+            geometry=self.geometry.take(idx),
+            columns={k: v[start:stop] for k, v in self.columns.items()},
+        )
+
+
+# ------------------------------------------------------------- shapefile
+
+_SHP_NULL = 0
+_SHP_POINT = 1
+_SHP_POLYLINE = 3
+_SHP_POLYGON = 5
+_SHP_MULTIPOINT = 8
+# Z/M variants share geometry layout with extra coordinate blocks
+_SHP_Z = {11: 1, 13: 3, 15: 5, 18: 8, 21: 1, 23: 3, 25: 5, 28: 8}
+
+
+def _read_shp(path: Path, srid: int) -> PackedGeometry:
+    d = path.read_bytes()
+    if len(d) < 100 or struct.unpack(">i", d[:4])[0] != 9994:
+        raise ValueError(f"not a shapefile: {path}")
+    b = GeometryBuilder()
+    o = 100
+    n = len(d)
+    while o + 8 <= n:
+        (_recno, clen) = struct.unpack(">ii", d[o : o + 8])
+        o += 8
+        rec = d[o : o + 2 * clen]
+        o += 2 * clen
+        if len(rec) < 4:
+            break
+        (stype,) = struct.unpack("<i", rec[:4])
+        base = _SHP_Z.get(stype, stype)
+        if stype == _SHP_NULL:
+            b.add_geometry(GeometryType.POINT, [[np.zeros((0, 2))]], srid)
+        elif base == _SHP_POINT:
+            x, y = struct.unpack("<dd", rec[4:20])
+            b.add_geometry(GeometryType.POINT, [[np.array([[x, y]])]], srid)
+        elif base == _SHP_MULTIPOINT:
+            (npts,) = struct.unpack("<i", rec[36:40])
+            pts = np.frombuffer(rec, "<f8", 2 * npts, 40).reshape(-1, 2)
+            b.add_geometry(
+                GeometryType.MULTIPOINT, [[p[None, :]] for p in pts], srid
+            )
+        elif base in (_SHP_POLYLINE, _SHP_POLYGON):
+            nparts, npts = struct.unpack("<ii", rec[36:44])
+            parts = np.frombuffer(rec, "<i4", nparts, 44)
+            pts = np.frombuffer(
+                rec, "<f8", 2 * npts, 44 + 4 * nparts
+            ).reshape(-1, 2)
+            rings = [
+                np.array(pts[parts[i] : (parts[i + 1] if i + 1 < nparts else npts)])
+                for i in range(nparts)
+            ]
+            if base == _SHP_POLYLINE:
+                b.add_geometry(
+                    GeometryType.MULTILINESTRING if len(rings) > 1 else GeometryType.LINESTRING,
+                    [[r] for r in rings],
+                    srid,
+                )
+            else:
+                _emit_shp_polygon(b, rings, srid)
+        else:
+            raise ValueError(f"unsupported shape type {stype}")
+    return b.build()
+
+
+def _emit_shp_polygon(b: GeometryBuilder, rings: list[np.ndarray], srid: int):
+    """Shapefile polygons: CW rings are shells, CCW are holes; holes belong
+    to the preceding shell (spec ordering). Drop the closing vertex."""
+    from ..core.types import open_ring, ring_signed_area
+
+    polys: list[list[np.ndarray]] = []
+    for r in rings:
+        xy, _ = open_ring(r)
+        if xy.shape[0] < 3:
+            continue
+        if ring_signed_area(xy) <= 0 or not polys:  # CW in shp = shell
+            polys.append([xy])
+        else:
+            polys[-1].append(xy)
+    if not polys:
+        b.add_geometry(GeometryType.POLYGON, [[np.zeros((0, 2))]], srid)
+    elif len(polys) == 1:
+        b.add_geometry(GeometryType.POLYGON, [polys[0]], srid)
+    else:
+        b.add_geometry(GeometryType.MULTIPOLYGON, polys, srid)
+
+
+def _read_dbf(path: Path) -> dict[str, np.ndarray]:
+    """dBASE III attribute table -> typed numpy columns (the OGR field
+    type-coercion analog, `OGRFileFormat.scala:156-203`)."""
+    if not path.exists():
+        return {}
+    d = path.read_bytes()
+    if len(d) < 32:
+        return {}
+    nrec = struct.unpack("<I", d[4:8])[0]
+    hdr_len, rec_len = struct.unpack("<HH", d[8:12])
+    fields = []
+    o = 32
+    while o + 32 <= hdr_len - 1 and d[o] != 0x0D:
+        raw = d[o : o + 32]
+        name = raw[:11].split(b"\0")[0].decode("ascii", "replace")
+        ftype = chr(raw[11])
+        flen = raw[16]
+        fdec = raw[17]
+        fields.append((name, ftype, flen, fdec))
+        o += 32
+    cols: dict[str, list] = {f[0]: [] for f in fields}
+    o = hdr_len
+    for _ in range(nrec):
+        if o + rec_len > len(d):
+            break
+        rec = d[o : o + rec_len]
+        o += rec_len
+        p = 1  # skip deletion flag
+        for name, ftype, flen, fdec in fields:
+            raw = rec[p : p + flen]
+            p += flen
+            s = raw.decode("latin-1").strip()
+            if ftype in ("N", "F"):
+                try:
+                    cols[name].append(float(s) if (fdec or "." in s) else int(s))
+                except ValueError:
+                    cols[name].append(np.nan if (fdec or "." in s) else 0)
+            elif ftype == "L":
+                cols[name].append(s.upper() in ("T", "Y"))
+            else:
+                cols[name].append(s)
+    out: dict[str, np.ndarray] = {}
+    for name, ftype, flen, fdec in fields:
+        vals = cols[name]
+        if ftype in ("N", "F"):
+            out[name] = np.asarray(
+                vals, dtype=np.float64 if (fdec or ftype == "F") else np.int64
+            )
+        elif ftype == "L":
+            out[name] = np.asarray(vals, dtype=bool)
+        else:
+            out[name] = np.asarray(vals, dtype=object)
+    return out
+
+
+def _read_prj_srid(path: Path) -> int:
+    """Best-effort EPSG from the .prj WKT."""
+    if not path.exists():
+        return 4326
+    wkt = path.read_text(errors="replace").upper()
+    if "OSGB" in wkt or "27700" in wkt:
+        return 27700
+    if "PSEUDO-MERCATOR" in wkt or "3857" in wkt:
+        return 3857
+    return 4326
+
+
+def read_shapefile(path: str) -> VectorTable:
+    """ESRI Shapefile (+ sidecar .dbf attributes, .prj CRS hint)."""
+    p = Path(path)
+    srid = _read_prj_srid(p.with_suffix(".prj"))
+    geom = _read_shp(p, srid)
+    cols = _read_dbf(p.with_suffix(".dbf"))
+    cols = {k: v[: len(geom)] for k, v in cols.items()}
+    return VectorTable(geometry=geom, columns=cols)
+
+
+# --------------------------------------------------------------- geojson
+
+
+def read_geojson(path_or_obj) -> VectorTable:
+    """GeoJSON FeatureCollection -> VectorTable (properties as columns)."""
+    geom, props = read_feature_collection(path_or_obj)
+    keys: list[str] = []
+    for pr in props:
+        for k in pr or {}:
+            if k not in keys:
+                keys.append(k)
+    cols = {}
+    for k in keys:
+        vals = [(pr or {}).get(k) for pr in props]
+        if all(isinstance(v, (int, float, type(None))) and not isinstance(v, bool) for v in vals):
+            cols[k] = np.asarray(
+                [np.nan if v is None else float(v) for v in vals]
+            )
+        else:
+            cols[k] = np.asarray(vals, dtype=object)
+    return VectorTable(geometry=geom, columns=cols)
+
+
+# ------------------------------------------------------------ CSV points
+
+
+def read_points_csv(
+    path: str,
+    lon_col: str,
+    lat_col: str,
+    max_rows: "int | None" = None,
+) -> VectorTable:
+    """Point table from CSV (the NYC-taxi trips ingestion path)."""
+    import csv
+
+    lons: list[float] = []
+    lats: list[float] = []
+    with open(path, newline="") as f:
+        rd = csv.DictReader(f)
+        for i, row in enumerate(rd):
+            if max_rows is not None and i >= max_rows:
+                break
+            try:
+                lons.append(float(row[lon_col]))
+                lats.append(float(row[lat_col]))
+            except (ValueError, KeyError):
+                lons.append(np.nan)
+                lats.append(np.nan)
+    from ..functions.formats import st_point
+
+    geom = st_point(np.asarray(lons), np.asarray(lats))
+    return VectorTable(
+        geometry=geom,
+        columns={lon_col: np.asarray(lons), lat_col: np.asarray(lats)},
+    )
+
+
+# ------------------------------------------------- multiread (chunked)
+
+
+def multiread(
+    paths: "list[str] | str",
+    reader=None,
+    chunk_size: int = 5000,
+    workers: int = 8,
+) -> VectorTable:
+    """Parallel chunked reads: partition = file x chunk (reference:
+    `OGRMultiReadDataFrameReader.load:25-77` computes
+    partitionCount = 1 + featureCount/chunkSize). Thread pool stands in for
+    Spark tasks; chunk tables are concatenated columnar."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    if isinstance(paths, str):
+        paths = [paths]
+    if reader is None:
+        reader = open_any
+
+    def load(p):
+        return reader(p)
+
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        tables = list(ex.map(load, paths))
+    # chunked re-partition of each table (parallelism seam for downstream)
+    chunks: list[VectorTable] = []
+    for t in tables:
+        for s in range(0, max(len(t), 1), chunk_size):
+            chunks.append(t.slice(s, s + chunk_size))
+    return concat_tables(chunks)
+
+
+def concat_tables(tables: "list[VectorTable]") -> VectorTable:
+    tables = [t for t in tables if len(t)]
+    if not tables:
+        raise ValueError("no rows")
+    b = GeometryBuilder()
+    for t in tables:
+        for g in range(len(t.geometry)):
+            b.append_from(t.geometry, g)
+    keys = {k for t in tables for k in t.columns}
+    cols = {}
+    for k in keys:
+        parts = [
+            t.columns.get(k, np.full(len(t), np.nan)) for t in tables
+        ]
+        try:
+            cols[k] = np.concatenate(parts)
+        except (TypeError, ValueError):
+            cols[k] = np.concatenate([np.asarray(p, dtype=object) for p in parts])
+    return VectorTable(geometry=b.build(), columns=cols)
+
+
+def open_any(path: str) -> VectorTable:
+    s = str(path).lower()
+    if s.endswith(".shp"):
+        return read_shapefile(path)
+    if s.endswith((".json", ".geojson")):
+        return read_geojson(path)
+    raise ValueError(f"no reader for {path}")

@@ -1,0 +1,216 @@
+"""Raster subsystem: native GeoTIFF reader, model, rst_ functions, pipeline.
+
+Validation targets: (a) round-trips through our own writer, (b) the real
+MODIS GeoTIFFs from the reference's test resources (tiled + deflate +
+predictor-2 int16 — decoded with an independent implementation, compared on
+internal consistency: sizes, geotransform arithmetic, nodata stats).
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu import functions as F
+from mosaic_tpu.core.index.h3 import H3IndexSystem
+from mosaic_tpu.raster import Raster, read_raster, write_geotiff
+from mosaic_tpu.readers import read
+
+MODIS = (
+    "/root/reference/src/test/resources/modis/"
+    "MCD43A4.A2018185.h10v07.006.2018194033728_B01.TIF"
+)
+
+
+def _toy_raster(bands=2, h=10, w=12, dtype=np.float32, nodata=-9.0):
+    rng = np.random.default_rng(7)
+    data = rng.uniform(0, 100, (bands, h, w)).astype(dtype)
+    data[:, :2, :3] = nodata
+    return Raster(
+        data=data,
+        gt=(-74.05, 0.01, 0.0, 40.78, 0.0, -0.01),
+        srid=4326,
+        nodata=float(nodata),
+    )
+
+
+def test_writer_reader_roundtrip(tmp_path):
+    r = _toy_raster()
+    p = tmp_path / "toy.tif"
+    write_geotiff(str(p), r)
+    back = read_raster(str(p))
+    np.testing.assert_array_equal(back.data, r.data)
+    np.testing.assert_allclose(back.gt, r.gt, atol=1e-12)
+    assert back.srid == 4326
+    assert back.nodata == -9.0
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.uint16, np.int16, np.int32, np.float64])
+def test_roundtrip_dtypes(tmp_path, dtype):
+    r = _toy_raster(bands=1, dtype=dtype, nodata=0)
+    p = tmp_path / "t.tif"
+    write_geotiff(str(p), r)
+    back = read_raster(str(p))
+    np.testing.assert_array_equal(back.data, r.data)
+    assert back.data.dtype == dtype
+
+
+def test_modis_decode():
+    r = read_raster(MODIS)
+    assert (r.width, r.height, r.num_bands) == (2400, 2400, 1)
+    assert r.data.dtype == np.int16
+    # MODIS sinusoidal 463.3127m pixels
+    assert r.gt[1] == pytest.approx(463.3127, abs=1e-3)
+    assert r.nodata == 32767
+    b = r.band(1)
+    assert 0.05 < b.mask.mean() < 0.2  # mostly-ocean tile
+    assert b.min() >= 0
+    assert r.metadata().get("_FillValue") == "32767"
+
+
+def test_rst_accessors():
+    r = _toy_raster()
+    assert F.rst_width([r])[0] == 12 and F.rst_height([r])[0] == 10
+    assert F.rst_numbands([r])[0] == 2
+    assert F.rst_scalex([r])[0] == pytest.approx(0.01)
+    assert F.rst_scaley([r])[0] == pytest.approx(-0.01)
+    assert F.rst_upperleftx([r])[0] == pytest.approx(-74.05)
+    assert F.rst_upperlefty([r])[0] == pytest.approx(40.78)
+    assert F.rst_skewx([r])[0] == 0 == F.rst_skewy([r])[0]
+    assert F.rst_pixelwidth([r])[0] == pytest.approx(0.01)
+    assert F.rst_rotation([r])[0] == 0
+    assert F.rst_srid([r])[0] == 4326
+    assert F.rst_memsize([r])[0] == r.data.nbytes
+    assert not F.rst_isempty([r])[0]
+    assert F.rst_georeference([r])[0]["scaleX"] == pytest.approx(0.01)
+    assert F.rst_summary([r])[0]["bands"] == 2
+    assert F.rst_subdatasets([r])[0] == {}
+
+
+def test_rst_coord_transforms():
+    r = _toy_raster()
+    # pixel (0,0) corner is the upper-left anchor
+    xy = F.rst_rastertoworldcoord([r], 0, 0)[0]
+    np.testing.assert_allclose(xy, [-74.05, 40.78])
+    assert F.rst_rastertoworldcoordx([r], 3, 2)[0] == pytest.approx(-74.05 + 0.03)
+    assert F.rst_rastertoworldcoordy([r], 3, 2)[0] == pytest.approx(40.78 - 0.02)
+    # world -> raster floors to the containing pixel
+    cr = F.rst_worldtorastercoord([r], -74.05 + 0.035, 40.78 - 0.025)[0]
+    np.testing.assert_array_equal(cr, [3, 2])
+    # mid-pixel probe (exact pixel edges are fp-boundary-sensitive, as in GDAL)
+    assert F.rst_worldtorastercoordx([r], -74.0 + 0.005, 40.7)[0] == 5
+    roundtrip = r.world_to_raster(*r.raster_to_world(7.25, 4.5))
+    np.testing.assert_allclose(roundtrip, (7.25, 4.5), atol=1e-9)
+
+
+def test_retile():
+    r = _toy_raster(bands=1, h=10, w=12)
+    tiles = F.rst_retile([r], 5, 4)
+    assert len(tiles) == 3 * 3
+    assert tiles[0].data.shape == (1, 4, 5)
+    assert tiles[-1].data.shape == (1, 2, 2)  # edge crop
+    # tile origin must map to the same world point as the parent pixel
+    t = tiles[4]  # second row, second col -> pixel (5, 4)
+    wx, wy = r.raster_to_world(5, 4)
+    assert t.gt[0] == pytest.approx(wx) and t.gt[3] == pytest.approx(wy)
+    # reassembled stats match
+    total = sum(t.data.sum() for t in tiles)
+    assert total == pytest.approx(r.data.sum(), rel=1e-6)
+
+
+def test_raster_to_grid_combiners():
+    idx = H3IndexSystem()
+    r = _toy_raster(bands=1, h=16, w=16)
+    avg = F.rst_rastertogridavg([r], 7, index=idx)[0][0]
+    cnt = F.rst_rastertogridcount([r], 7, index=idx)[0][0]
+    mn = F.rst_rastertogridmin([r], 7, index=idx)[0][0]
+    mx = F.rst_rastertogridmax([r], 7, index=idx)[0][0]
+    med = F.rst_rastertogridmedian([r], 7, index=idx)[0][0]
+    assert set(avg) == set(cnt) == set(mn) == set(mx) == set(med)
+    assert len(avg) >= 1
+    # counts total = number of valid pixels
+    valid = int(r.band(1).mask.sum())
+    assert int(sum(cnt.values())) == valid
+    for c in avg:
+        assert mn[c] <= med[c] <= mx[c]
+        assert mn[c] <= avg[c] <= mx[c]
+    # oracle recompute for one cell
+    cells = np.asarray(
+        idx.point_to_cell(
+            np.stack(r.pixel_centers(), axis=-1), 7
+        )
+    )
+    vals = r.band(1).values.ravel().astype(np.float64)
+    mask = r.band(1).mask.ravel()
+    c0 = next(iter(avg))
+    sel = (cells == c0) & mask
+    assert avg[c0] == pytest.approx(vals[sel].mean())
+
+
+def test_checkpoint_save(tmp_path):
+    r = _toy_raster(bands=1)
+    p = r.save_checkpoint(str(tmp_path / "ckpt"))
+    back = read_raster(p)
+    np.testing.assert_array_equal(back.data, r.data)
+
+
+def test_reader_registry_gdal_and_grid():
+    meta = read("gdal").load(MODIS)
+    assert meta[0]["xSize"] == 2400 and meta[0]["bandCount"] == 1
+    idx = H3IndexSystem()
+    # MODIS srid is user-defined (32767) -> treat coordinates as-is would be
+    # wrong; pass rasterSrid override skipping transform is not meaningful
+    # for sinusoidal, so use the toy raster through the full pipeline:
+    r = _toy_raster(bands=1, h=16, w=16)
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "t.tif")
+        write_geotiff(path, r)
+        grid = read("raster_to_grid").option("resolution", 7).option(
+            "index", idx
+        ).load(path)
+        assert 1 in grid and len(grid[1]) >= 1
+        ref = F.rst_rastertogridavg([r], 7, index=idx)[0][0]
+        for c, v in grid[1].items():
+            assert v == pytest.approx(ref[c], rel=1e-6)
+        smoothed = read("raster_to_grid").option("resolution", 7).option(
+            "index", idx
+        ).option("kRingInterpolate", 1).load(path)
+        assert set(smoothed[1]) >= set(grid[1])  # ring extends coverage
+        for c, v in grid[1].items():
+            assert smoothed[1][c] == pytest.approx(v)  # measured cells kept
+
+
+def test_shapefile_reader(tmp_path):
+    # build a tiny shapefile by hand (spec-conformant) and read it back
+    import struct
+
+    shp = tmp_path / "poly.shp"
+    # one polygon record: CW square shell
+    ring = [(0.0, 0.0), (0.0, 4.0), (4.0, 4.0), (4.0, 0.0), (0.0, 0.0)]
+    rec = struct.pack("<i", 5)  # polygon
+    rec += struct.pack("<4d", 0, 0, 4, 4)  # bbox
+    rec += struct.pack("<ii", 1, len(ring))
+    rec += struct.pack("<i", 0)
+    for x, y in ring:
+        rec += struct.pack("<dd", x, y)
+    content = struct.pack(">ii", 1, len(rec) // 2) + rec
+    header = struct.pack(">i", 9994) + b"\0" * 20
+    header += struct.pack(">i", (100 + len(content)) // 2)
+    header += struct.pack("<ii", 1000, 5)
+    header += struct.pack("<8d", 0, 0, 4, 4, 0, 0, 0, 0)
+    shp.write_bytes(header + content)
+    t = read("shapefile").load(str(shp))
+    assert len(t) == 1
+    assert F.st_area(t.geometry, backend="oracle")[0] == pytest.approx(16.0)
+
+
+def test_points_csv_reader(tmp_path):
+    p = tmp_path / "pts.csv"
+    p.write_text(
+        "id,pickup_longitude,pickup_latitude\n1,-73.99,40.75\n2,-73.98,40.76\n"
+    )
+    t = read("csv_points").load(str(p))
+    assert len(t) == 2
+    np.testing.assert_allclose(
+        F.st_x(t.geometry), [-73.99, -73.98]
+    )
