@@ -19,15 +19,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .device import DeviceGeometry, edges
+from .device import DeviceGeometry, edges, is_polygonal
 
 _BIG = 1e30
 
 
 def _poly_edges(polys: DeviceGeometry):
     """Edges (a, b) with the closed-ring mask — for ray-crossing PIP where
-    only polygon rings matter. Shapes (G, R, V-1, 2)."""
+    only polygon rings matter. Shapes (G, R, V-1, 2). Non-polygonal rows get
+    an all-false mask: a POINT's single-vertex ring would otherwise
+    contribute a phantom edge to the zero pad and flip crossing parity."""
     a, b, poly_mask, _, _ = edges(polys)
+    poly_mask = poly_mask & is_polygonal(polys.geom_type)[:, None, None]
     return a, b, poly_mask
 
 

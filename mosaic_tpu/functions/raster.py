@@ -191,7 +191,13 @@ def _pixel_cells(
     srid = raster_srid if raster_srid is not None else (r.srid or 4326)
     xy = np.stack([x, y], axis=-1)
     target = getattr(index, "crs_srid", 4326)
-    if target and srid != target and _crs.supported(srid):
+    if target and srid != target:
+        if not _crs.supported(srid):
+            raise ValueError(
+                f"raster SRID {srid} cannot be transformed to the index "
+                f"CRS (EPSG:{target}); pass raster_srid explicitly or use "
+                f"a CUSTOM index in the raster's own CRS"
+            )
         xy = _crs.transform_points(xy, srid, target)
     return np.asarray(
         index.point_to_cell(jnp.asarray(xy), resolution), dtype=np.int64

@@ -1,0 +1,11 @@
+"""Models & services (reference: `models/` — SpatialKNN + core transformers)."""
+
+from .core import CheckpointManager, IterativeTransformer  # noqa: F401
+from .knn import GridRingNeighbours, SpatialKNN  # noqa: F401
+
+__all__ = [
+    "CheckpointManager",
+    "IterativeTransformer",
+    "GridRingNeighbours",
+    "SpatialKNN",
+]

@@ -1,0 +1,93 @@
+"""Model-support primitives: checkpoints + iterate-until-converged.
+
+Reference analogs: `models/util/CheckpointManager.scala:12-103` (Delta-backed
+append/overwrite/load used as the per-iteration durability barrier) and
+`models/core/IterativeTransformer.scala:49-87` (the generic fold with
+early-stopping). Delta tables become directories of ``.npz`` array bundles —
+the natural durable format for a columnar host runtime; each iteration's
+arrays are one file, append = add file, load = concatenate.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+from pathlib import Path
+from typing import Callable
+
+import numpy as np
+
+
+class CheckpointManager:
+    """Durable array-table checkpoints (append/overwrite/load).
+
+    A "table" is a dict[str, np.ndarray] of equal-length columns; each
+    append writes ``part-<n>.npz``. Mirrors the reference's isTable=false
+    directory mode (`CheckpointManager.scala`).
+    """
+
+    def __init__(self, location: str, overwrite: bool = False):
+        self.dir = Path(location)
+        if overwrite and self.dir.exists():
+            shutil.rmtree(self.dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+
+    def _parts(self) -> list[Path]:
+        return sorted(self.dir.glob("part-*.npz"))
+
+    def append(self, table: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        n = len(self._parts())
+        np.savez(self.dir / f"part-{n:05d}.npz", **table)
+        return self.load()
+
+    def overwrite(self, table: dict[str, np.ndarray]) -> None:
+        for p in self._parts():
+            p.unlink()
+        np.savez(self.dir / "part-00000.npz", **table)
+
+    def load(self) -> dict[str, np.ndarray]:
+        parts = self._parts()
+        if not parts:
+            return {}
+        loaded = [dict(np.load(p, allow_pickle=True)) for p in parts]
+        keys = loaded[0].keys()
+        return {k: np.concatenate([d[k] for d in loaded]) for k in keys}
+
+    def write_meta(self, meta: dict) -> None:
+        (self.dir / "meta.json").write_text(json.dumps(meta, default=str))
+
+    def read_meta(self) -> dict:
+        p = self.dir / "meta.json"
+        return json.loads(p.read_text()) if p.exists() else {}
+
+    def delete(self) -> None:
+        if self.dir.exists():
+            shutil.rmtree(self.dir)
+
+
+class IterativeTransformer:
+    """Iterate ``step`` until ``should_stop`` or ``max_iterations``
+    (reference: `IterativeTransformer.iterate:49-87`). State is whatever the
+    caller threads through; each iteration may persist via a
+    CheckpointManager (the Spark `.checkpoint(true)` barrier analog)."""
+
+    def __init__(
+        self,
+        step: Callable,
+        should_stop: Callable,
+        max_iterations: int,
+    ):
+        self.step = step
+        self.should_stop = should_stop
+        self.max_iterations = max_iterations
+        self.iterations_run = 0
+
+    def iterate(self, state):
+        prev = state
+        for i in range(1, self.max_iterations + 1):
+            self.iterations_run = i
+            state = self.step(prev, i)
+            if self.should_stop(prev, state):
+                break
+            prev = state
+        return state

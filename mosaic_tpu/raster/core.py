@@ -287,8 +287,10 @@ def read_raster(path: str) -> Raster:
     buf = ctypes.string_at(px, n)
     l.mg_tiff_free(px)
     data = np.frombuffer(buf, dtype=dtype).reshape(bands, h, w).copy()
+    # meta is malloc'd in C; .value copies the bytes, then free the original
     meta_xml = meta.value.decode("utf-8", "replace") if meta.value else ""
-    # meta is malloc'd in C; ctypes c_char_p copies, free the original
+    if meta.value is not None:
+        l.mg_tiff_free(meta)
     return Raster(
         data=data,
         gt=tuple(float(dinfo[i]) for i in range(6)),
@@ -349,8 +351,19 @@ def write_geotiff(path: str, raster: Raster) -> None:
     # strip offsets filled after layout; one strip per band
     e_long(273, *([0] * bands))
     e_long(279, *([plane] * bands))
-    e_dbl(33550, abs(sx), abs(sy), 0.0)
-    e_dbl(33922, 0.0, 0.0, 0.0, x0, y0, 0.0)
+    if rx == 0.0 and ry == 0.0 and sx > 0 and sy < 0:
+        # north-up axis-aligned: the conventional PixelScale + Tiepoint pair
+        e_dbl(33550, sx, -sy, 0.0)
+        e_dbl(33922, 0.0, 0.0, 0.0, x0, y0, 0.0)
+    else:
+        # rotated / skewed / south-up: full ModelTransformation matrix
+        e_dbl(
+            34264,
+            sx, rx, 0.0, x0,
+            ry, sy, 0.0, y0,
+            0.0, 0.0, 0.0, 0.0,
+            0.0, 0.0, 0.0, 1.0,
+        )
     if raster.srid:
         # minimal GeoKeyDirectory: version, revision, minor, count + one key
         geographic = 4000 <= raster.srid < 5000

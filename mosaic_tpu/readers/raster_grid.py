@@ -23,8 +23,12 @@ def _list_paths(path: "str | list[str]", ext: "str | None") -> list[str]:
         return [str(p) for p in path]
     p = Path(path)
     if p.is_dir():
-        pat = f"*{ext}" if ext else "*"
-        return sorted(str(q) for q in p.glob(pat))
+        suffix = (ext or "").lower()
+        return sorted(
+            str(q)
+            for q in p.iterdir()
+            if q.is_file() and q.name.lower().endswith(suffix)
+        )
     if any(c in str(path) for c in "*?["):
         return sorted(_glob.glob(str(path)))
     return [str(path)]
@@ -73,39 +77,56 @@ def raster_to_grid(
         index = current_context().index_system
     resolution = index.resolution_arg(resolution)
 
+    if combiner not in ("avg", "min", "max", "median", "count"):
+        raise ValueError(f"unknown combiner {combiner!r}")
+
+    # Per-cell accumulation across tiles and files (the reference's final
+    # group-by(band, cell) combine, `RasterAsGridReader.scala:61-76`).
+    # avg is merged pixel-weighted (sum of avg*count / sum of count) so cells
+    # straddling tile boundaries combine exactly; median is not mergeable
+    # from per-tile medians, so median skips retiling and runs whole-raster.
     per_band_acc: dict[int, dict[int, list]] = {}
+    fn = getattr(RF, f"rst_rastertogrid{combiner}")
     for p in _list_paths(path, ext):
         r = read_raster(p)
-        tiles = r.retile(tile_size, tile_size) if (
+        can_tile = combiner != "median"
+        tiles = r.retile(tile_size, tile_size) if can_tile and (
             r.width > tile_size or r.height > tile_size
         ) else [r]
-        fn = getattr(RF, f"rst_rastertogrid{combiner}")
         for t in tiles:
             res = fn([t], resolution, index=index, raster_srid=raster_srid)[0]
+            if combiner == "avg":
+                cnt = RF.rst_rastertogridcount(
+                    [t], resolution, index=index, raster_srid=raster_srid
+                )[0]
             for b, cellmap in enumerate(res, start=1):
                 acc = per_band_acc.setdefault(b, {})
                 for cell, val in cellmap.items():
-                    acc.setdefault(cell, []).append(val)
+                    if combiner == "avg":
+                        acc.setdefault(cell, []).append(
+                            (val * cnt[b - 1][cell], cnt[b - 1][cell])
+                        )
+                    else:
+                        acc.setdefault(cell, []).append(val)
 
-    # merge tile/file contributions per cell (the reference's final
-    # group-by(band, cell) combine, `RasterAsGridReader.scala:61-76`)
     merged: dict[int, dict[int, float]] = {}
     for b, acc in per_band_acc.items():
         cells = {}
         for cell, vals in acc.items():
-            v = np.asarray(vals, dtype=np.float64)
             if combiner == "avg":
-                cells[cell] = float(v.mean())
-            elif combiner == "min":
+                s = sum(v[0] for v in vals)
+                c = sum(v[1] for v in vals)
+                cells[cell] = float(s / c) if c else float("nan")
+                continue
+            v = np.asarray(vals, dtype=np.float64)
+            if combiner == "min":
                 cells[cell] = float(v.min())
             elif combiner == "max":
                 cells[cell] = float(v.max())
             elif combiner == "median":
-                cells[cell] = float(np.median(v))
+                cells[cell] = float(v[0]) if v.size == 1 else float(np.median(v))
             elif combiner == "count":
                 cells[cell] = float(v.sum())
-            else:
-                raise ValueError(f"unknown combiner {combiner!r}")
         merged[b] = cells
 
     if k_ring_interpolate > 0:

@@ -170,9 +170,14 @@ def _read_dbf(path: Path) -> dict[str, np.ndarray]:
     for name, ftype, flen, fdec in fields:
         vals = cols[name]
         if ftype in ("N", "F"):
-            out[name] = np.asarray(
-                vals, dtype=np.float64 if (fdec or ftype == "F") else np.int64
-            )
+            want_int = not fdec and ftype == "N"
+            try:
+                out[name] = np.asarray(
+                    vals, dtype=np.int64 if want_int else np.float64
+                )
+            except (ValueError, OverflowError):
+                # malformed cells fell back to NaN: keep the column as float
+                out[name] = np.asarray(vals, dtype=np.float64)
         elif ftype == "L":
             out[name] = np.asarray(vals, dtype=bool)
         else:

@@ -1,0 +1,102 @@
+"""MosaicAnalyzer: optimal grid-resolution estimation.
+
+Reference analog: `sql/MosaicAnalyzer.scala:28-129` — sample the geometry
+column, compare area percentiles against the mean cell area per resolution,
+and pick the resolution whose cells-per-geometry ratio falls inside a target
+band. `SampleStrategy` (`sql/SampleStrategy.scala:5`) becomes a plain
+(fraction, limit) pair.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..core.index.base import IndexSystem
+from ..functions._coerce import to_packed
+
+
+@dataclasses.dataclass
+class SampleStrategy:
+    fraction: float = 1.0
+    limit: "int | None" = None
+
+    def apply(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        take = int(np.ceil(n * self.fraction))
+        if self.limit is not None:
+            take = min(take, self.limit)
+        take = max(1, min(take, n))
+        return rng.choice(n, size=take, replace=False)
+
+
+class MosaicAnalyzer:
+    """Pick the resolution where a typical geometry spans ``target_cells``
+    grid cells (the reference defaults to ~16-256 cells per geometry)."""
+
+    def __init__(self, index: IndexSystem, target_cells: float = 64.0):
+        self.index = index
+        self.target_cells = target_cells
+
+    def _geometry_areas(self, col, sample: SampleStrategy, seed: int) -> np.ndarray:
+        packed = to_packed(col)
+        rng = np.random.default_rng(seed)
+        rows = sample.apply(len(packed), rng)
+        from ..core.geometry import oracle
+
+        areas = oracle.area(packed)[rows]
+        areas = areas[np.isfinite(areas) & (areas > 0)]
+        if areas.size == 0:
+            raise ValueError("no polygonal geometries to analyze")
+        return areas
+
+    def get_optimal_resolution(
+        self,
+        col,
+        sample: "SampleStrategy | None" = None,
+        percentile: float = 50.0,
+        seed: int = 0,
+    ) -> int:
+        """Resolution whose mean cell area is closest to
+        geometry_area(percentile) / target_cells
+        (reference: `getOptimalResolution:28-39`)."""
+        sample = sample or SampleStrategy()
+        areas = self._geometry_areas(col, sample, seed)
+        target_cell_area = np.percentile(areas, percentile) / self.target_cells
+        best, best_err = None, np.inf
+        for res in self.index.resolutions():
+            try:
+                ca = self.index.cell_area_approx(res)
+            except NotImplementedError:
+                continue
+            err = abs(np.log(ca / target_cell_area))
+            if err < best_err:
+                best, best_err = res, err
+        if best is None:
+            raise ValueError("index system exposes no cell areas")
+        return int(best)
+
+    def get_resolution_metrics(
+        self,
+        col,
+        sample: "SampleStrategy | None" = None,
+        seed: int = 0,
+    ) -> dict[int, dict[str, float]]:
+        """Per-resolution cells-per-geometry percentiles (reference:
+        `getResolutionMetrics:41-100`)."""
+        sample = sample or SampleStrategy()
+        areas = self._geometry_areas(col, sample, seed)
+        out: dict[int, dict[str, float]] = {}
+        for res in self.index.resolutions():
+            try:
+                ca = self.index.cell_area_approx(res)
+            except NotImplementedError:
+                continue
+            ratio = areas / ca
+            out[int(res)] = {
+                "mean_cells": float(ratio.mean()),
+                "p25_cells": float(np.percentile(ratio, 25)),
+                "p50_cells": float(np.percentile(ratio, 50)),
+                "p75_cells": float(np.percentile(ratio, 75)),
+            }
+        return out

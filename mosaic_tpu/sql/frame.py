@@ -1,0 +1,131 @@
+"""MosaicFrame: a geometry-aware columnar table.
+
+Reference analog: `sql/MosaicFrame.scala:15-374` — a DataFrame subclass that
+carries geometry-column roles, the chosen index resolution, and
+exploded-or-array indexing state in column metadata, plus `Prettifier`
+(`sql/Prettifier.scala:14-18`). Here the table is a plain dict of numpy
+columns + a PackedGeometry, and the index state is explicit fields.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..core.tessellate import ChipTable
+from ..core.types import PackedGeometry
+from ..functions._coerce import to_packed
+
+
+@dataclasses.dataclass
+class MosaicFrame:
+    """Geometry column + attributes + grid-index bookkeeping."""
+
+    geometry: PackedGeometry
+    columns: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    resolution: "int | None" = None
+    chips: "ChipTable | None" = None  # set by set_index_resolution
+
+    # ------------------------------------------------------------ builders
+    @classmethod
+    def from_table(cls, table) -> "MosaicFrame":
+        """From a readers.VectorTable."""
+        return cls(geometry=table.geometry, columns=dict(table.columns))
+
+    @classmethod
+    def from_geometry(cls, geom, **columns) -> "MosaicFrame":
+        return cls(
+            geometry=to_packed(geom),
+            columns={k: np.asarray(v) for k, v in columns.items()},
+        )
+
+    def __len__(self) -> int:
+        return len(self.geometry)
+
+    # ------------------------------------------------------------ indexing
+    def get_optimal_resolution(self, index=None, **kwargs) -> int:
+        from .analyzer import MosaicAnalyzer
+
+        if index is None:
+            from ..context import current_context
+
+            index = current_context().index_system
+        return MosaicAnalyzer(index).get_optimal_resolution(
+            self.geometry, **kwargs
+        )
+
+    def set_index_resolution(
+        self, resolution: int, index=None, keep_core_geoms: bool = False
+    ) -> "MosaicFrame":
+        """Tessellate the geometry column and attach the chip table
+        (reference: `setIndexResolution` + `applyIndex`)."""
+        from ..functions.grid import grid_tessellate
+
+        chips = grid_tessellate(
+            self.geometry, resolution, keep_core_geoms=keep_core_geoms,
+            index=index,
+        )
+        return dataclasses.replace(self, resolution=resolution, chips=chips)
+
+    # --------------------------------------------------------------- joins
+    def point_in_polygon_join(
+        self, points: "MosaicFrame", index=None, resolution: "int | None" = None
+    ) -> dict[str, np.ndarray]:
+        """Managed PIP join: this frame = polygons, other = points
+        (reference: `PointInPolygonJoin.join:15-37`). Returns the joined
+        column dict (point columns + matched polygon row + polygon columns).
+        """
+        from ..sql.join import pip_join
+
+        if index is None:
+            from ..context import current_context
+
+            index = current_context().index_system
+        res = resolution or self.resolution or self.get_optimal_resolution(index)
+        pts = np.stack(
+            [
+                _point_coords(points.geometry, 0),
+                _point_coords(points.geometry, 1),
+            ],
+            axis=-1,
+        )
+        match = pip_join(pts, self.geometry, index, res)
+        out = {k: v.copy() for k, v in points.columns.items()}
+        out["polygon_row"] = match
+        ok = match >= 0
+        safe = np.maximum(match, 0)
+        for k, v in self.columns.items():
+            col = np.asarray(v)[safe]
+            if col.dtype.kind in "fiu":  # numeric -> NaN mask
+                col = np.where(ok, col.astype(np.float64), np.nan)
+            else:  # strings/objects -> None mask
+                col = np.where(ok, col.astype(object), None)
+            out[f"polygon_{k}"] = col
+        return out
+
+    # ------------------------------------------------------------- display
+    def prettified(self, n: int = 10) -> str:
+        """Reference: `Prettifier.prettified` — compact preview."""
+        from ..core.geometry.wkt import to_wkt
+
+        rows = min(n, len(self))
+        idx = list(range(rows))
+        wkts = to_wkt(self.geometry.take(idx))
+        lines = []
+        header = ["geometry"] + list(self.columns)
+        lines.append(" | ".join(header))
+        for i in idx:
+            w = wkts[i] if len(wkts[i]) < 60 else wkts[i][:57] + "..."
+            vals = [w] + [str(self.columns[k][i]) for k in self.columns]
+            lines.append(" | ".join(vals))
+        return "\n".join(lines)
+
+
+def _point_coords(col: PackedGeometry, axis: int) -> np.ndarray:
+    out = np.full(len(col), np.nan)
+    for g in range(len(col)):
+        xy = col.geom_xy(g)
+        if xy.shape[0]:
+            out[g] = xy[0, axis]
+    return out

@@ -336,6 +336,8 @@ static int readTiff(const uint8_t* data, size_t n, Info& info,
   uint16_t comp = (uint16_t)scalar(r, ifd.find(259), 1);
   uint16_t planar = (uint16_t)scalar(r, ifd.find(284), 1);
   uint16_t predictor = (uint16_t)scalar(r, ifd.find(317), 1);
+  if (predictor > 2) return -12;  // float predictor 3 unsupported: refuse
+                                  // rather than return shuffled garbage
 
   // georeference
   auto scale = doubles(r, ifd.find(33550));
@@ -390,7 +392,6 @@ static int readTiff(const uint8_t* data, size_t n, Info& info,
   int64_t across = (info.width + cw - 1) / cw;
   int64_t down = (info.height + ch - 1) / ch;
   size_t chunkSpp = planar == 2 ? 1 : spp;
-  size_t rawn = (size_t)cw * (size_t)ch * chunkSpp * bysz;
   size_t planeChunks = (size_t)(across * down);
   size_t needed = planar == 2 ? planeChunks * spp : planeChunks;
   if (offs.size() < needed) return -8;
@@ -398,24 +399,28 @@ static int readTiff(const uint8_t* data, size_t n, Info& info,
   size_t total = (size_t)info.bands * info.width * info.height * bysz;
   uint8_t* out = (uint8_t*)malloc(std::max<size_t>(total, 1));
   if (!out) return -1;
-  std::vector<uint8_t> chunk(rawn);
+  std::vector<uint8_t> chunk((size_t)cw * (size_t)ch * chunkSpp * bysz);
 
   for (size_t c = 0; c < needed; ++c) {
-    if (!decodeChunk(r, (size_t)offs[c], (size_t)cnts[c], comp, chunk.data(),
-                     rawn)) {
-      free(out);
-      return -9;
-    }
-    // per-row fixups
-    for (int64_t y = 0; y < ch; ++y)
-      fixRow(chunk.data() + (size_t)y * cw * chunkSpp * bysz, (size_t)cw,
-             chunkSpp, bysz, r.le, predictor, info.dtype);
     size_t plane = planar == 2 ? c / planeChunks : 0;
     size_t ci = planar == 2 ? c % planeChunks : c;
     int64_t ty = (int64_t)(ci / across), tx = (int64_t)(ci % across);
     int64_t x0 = tx * cw, y0 = ty * ch;
     int64_t copyw = std::min(cw, info.width - x0);
     int64_t copyh = std::min(ch, info.height - y0);
+    // tiles are padded to full size on disk; the FINAL strip of a striped
+    // file is short (only the remaining rows are stored)
+    int64_t rows = tiled ? ch : copyh;
+    size_t rawn = (size_t)cw * (size_t)rows * chunkSpp * bysz;
+    if (!decodeChunk(r, (size_t)offs[c], (size_t)cnts[c], comp, chunk.data(),
+                     rawn)) {
+      free(out);
+      return -9;
+    }
+    // per-row fixups
+    for (int64_t y = 0; y < rows; ++y)
+      fixRow(chunk.data() + (size_t)y * cw * chunkSpp * bysz, (size_t)cw,
+             chunkSpp, bysz, r.le, predictor, info.dtype);
     for (int64_t y = 0; y < copyh; ++y) {
       const uint8_t* srow = chunk.data() + (size_t)y * cw * chunkSpp * bysz;
       if (planar == 2 || spp == 1) {

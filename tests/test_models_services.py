@@ -1,0 +1,193 @@
+"""Services: SpatialKNN, analyzer, MosaicFrame, checkpoints, iteration.
+
+KNN correctness oracle: brute-force pairwise distances in f64 numpy over
+small synthetic landmark/candidate sets — the grid-ring result (exact mode)
+must produce identical neighbour sets; approximate mode must produce k
+matches with non-decreasing distances.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu import MosaicContext
+from mosaic_tpu import functions as F
+from mosaic_tpu.core.index.h3 import H3IndexSystem
+from mosaic_tpu.datasets import random_points, synthetic_zones
+from mosaic_tpu.models import CheckpointManager, IterativeTransformer, SpatialKNN
+from mosaic_tpu.sql.analyzer import MosaicAnalyzer, SampleStrategy
+from mosaic_tpu.sql.frame import MosaicFrame
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    MosaicContext.reset()
+    yield
+    MosaicContext.reset()
+
+
+# -------------------------------------------------------------- checkpoints
+
+
+def test_checkpoint_manager(tmp_path):
+    ck = CheckpointManager(str(tmp_path / "ck"))
+    t1 = {"a": np.arange(3), "b": np.ones(3)}
+    out = ck.append(t1)
+    assert out["a"].tolist() == [0, 1, 2]
+    out = ck.append({"a": np.arange(3, 5), "b": np.zeros(2)})
+    assert out["a"].tolist() == [0, 1, 2, 3, 4]
+    ck.overwrite({"a": np.array([9]), "b": np.array([9.0])})
+    assert ck.load()["a"].tolist() == [9]
+    ck.write_meta({"k": 5})
+    assert ck.read_meta()["k"] == 5
+    ck.delete()
+    assert not (tmp_path / "ck").exists()
+
+
+def test_iterative_transformer():
+    steps = []
+
+    def step(state, i):
+        steps.append(i)
+        return state + i
+
+    it = IterativeTransformer(
+        step, should_stop=lambda prev, cur: cur >= 6, max_iterations=10
+    )
+    out = it.iterate(0)
+    assert out == 6  # 0+1+2+3
+    assert it.iterations_run == 3
+
+
+# ----------------------------------------------------------------- analyzer
+
+
+def test_analyzer_resolution():
+    idx = H3IndexSystem()
+    zones = synthetic_zones(4, 4, bbox=(-74.05, 40.60, -73.85, 40.78))
+    res = MosaicAnalyzer(idx).get_optimal_resolution(zones)
+    assert res in idx.resolutions()
+    # a typical zone should span roughly target_cells cells at that res
+    from mosaic_tpu.core.geometry import oracle
+
+    med_area = np.median(oracle.area(zones))
+    ratio = med_area / idx.cell_area_approx(res)
+    assert 4 <= ratio <= 1024  # within ~half/double of the 64-cell target band
+
+    metrics = MosaicAnalyzer(idx).get_resolution_metrics(zones)
+    assert res in metrics and "p50_cells" in metrics[res]
+    s = SampleStrategy(fraction=0.5, limit=4)
+    assert MosaicAnalyzer(idx).get_optimal_resolution(zones, sample=s) in idx.resolutions()
+
+
+# -------------------------------------------------------------- MosaicFrame
+
+
+def test_mosaic_frame_join():
+    zones = synthetic_zones(3, 3, bbox=(-74.05, 40.60, -73.85, 40.78))
+    names = np.array([f"z{i}" for i in range(len(zones))], dtype=object)
+    polys = MosaicFrame.from_geometry(zones, name=names, code=np.arange(len(zones)))
+    pts = random_points(500, bbox=(-74.05, 40.60, -73.85, 40.78), seed=5)
+    points = MosaicFrame.from_geometry(
+        F.st_point(pts[:, 0], pts[:, 1]), pid=np.arange(500)
+    )
+    joined = polys.point_in_polygon_join(points, resolution=8)
+    assert joined["polygon_row"].shape == (500,)
+    hit = joined["polygon_row"] >= 0
+    assert hit.mean() > 0.5
+    # joined attributes line up with the matched polygon
+    for i in np.nonzero(hit)[0][:20]:
+        assert joined["polygon_name"][i] == f"z{joined['polygon_row'][i]}"
+    # oracle check on a few points
+    from mosaic_tpu.core.geometry import oracle
+
+    for i in range(0, 100, 7):
+        row = joined["polygon_row"][i]
+        if row >= 0:
+            assert oracle.point_in_polygon(zones, int(row), pts[i])
+
+
+def test_mosaic_frame_utils():
+    zones = synthetic_zones(2, 2, bbox=(-74.0, 40.6, -73.9, 40.7))
+    f = MosaicFrame.from_geometry(zones, name=np.array(["a", "b", "c", "d"], dtype=object))
+    assert len(f) == 4
+    res = f.get_optimal_resolution(H3IndexSystem())
+    fi = f.set_index_resolution(res, index=H3IndexSystem())
+    assert fi.chips is not None and len(fi.chips) > 0
+    s = f.prettified(2)
+    assert "geometry" in s and "name" in s
+
+
+# ---------------------------------------------------------------------- KNN
+
+
+def _knn_oracle(land_pts, cand_pts, k):
+    """Brute-force k nearest candidate ids per landmark (point-point)."""
+    d = np.linalg.norm(land_pts[:, None, :] - cand_pts[None, :, :], axis=-1)
+    order = np.argsort(d, axis=1, kind="stable")[:, :k]
+    return order, np.take_along_axis(d, order, axis=1)
+
+
+def test_spatial_knn_exact_points():
+    rng = np.random.default_rng(11)
+    bbox = (-74.05, 40.60, -73.85, 40.78)
+    land_pts = random_points(12, bbox=bbox, seed=1)
+    cand_pts = random_points(80, bbox=bbox, seed=2)
+    land = F.st_point(land_pts[:, 0], land_pts[:, 1])
+    cand = F.st_point(cand_pts[:, 0], cand_pts[:, 1])
+    knn = SpatialKNN(
+        index=H3IndexSystem(), resolution=8, k_neighbours=3,
+        max_iterations=30, approximate=False,
+    )
+    res = knn.transform(land, cand)
+    want_ids, want_d = _knn_oracle(land_pts, cand_pts, 3)
+    assert res.metrics["complete_landmarks"] == 12
+    for i in range(12):
+        got = res.candidate_id[res.landmark_id == i]
+        got_d = res.distance[res.landmark_id == i]
+        assert got.shape == (3,)
+        np.testing.assert_allclose(np.sort(got_d), got_d)  # ranked
+        np.testing.assert_allclose(got_d, want_d[i], atol=1e-5)
+        assert set(got) == set(want_ids[i])
+
+
+def test_spatial_knn_polygons_and_checkpoint(tmp_path):
+    bbox = (-74.05, 40.60, -73.85, 40.78)
+    zones = synthetic_zones(4, 4, bbox=bbox)
+    land_pts = random_points(5, bbox=bbox, seed=3)
+    land = F.st_point(land_pts[:, 0], land_pts[:, 1])
+    knn = SpatialKNN(
+        index=H3IndexSystem(), resolution=8, k_neighbours=2,
+        max_iterations=25, approximate=False,
+        checkpoint_dir=str(tmp_path / "knn_ck"),
+    )
+    res = knn.transform(land, zones)
+    assert res.metrics["complete_landmarks"] == 5
+    # nearest polygon distance 0 when the point is inside a zone
+    from mosaic_tpu.sql.join import pip_join
+
+    inside = pip_join(land_pts, zones, H3IndexSystem(), 8)
+    for i in range(5):
+        d1 = res.distance[(res.landmark_id == i) & (res.rank == 1)]
+        if inside[i] >= 0:
+            assert d1[0] == pytest.approx(0.0, abs=1e-6)
+    # checkpoint recorded iterations
+    ck = CheckpointManager(str(tmp_path / "knn_ck"))
+    log = ck.load()
+    assert "iteration" in log and log["iteration"].max() >= 1
+    assert ck.read_meta()["match_count"] == res.metrics["match_count"]
+
+
+def test_spatial_knn_threshold_and_early_stop():
+    bbox = (-74.05, 40.60, -73.85, 40.78)
+    land = F.st_point(np.array([-74.0]), np.array([40.7]))
+    cand_pts = random_points(50, bbox=bbox, seed=9)
+    cand = F.st_point(cand_pts[:, 0], cand_pts[:, 1])
+    knn = SpatialKNN(
+        index=H3IndexSystem(), resolution=8, k_neighbours=50,
+        max_iterations=6, early_stop_iterations=2,
+        distance_threshold=0.01,
+    )
+    res = knn.transform(land, cand)
+    assert (res.distance <= 0.01).all()
+    d = np.linalg.norm(cand_pts - np.array([-74.0, 40.7]), axis=-1)
+    assert res.metrics["match_count"] <= int((d <= 0.01).sum())

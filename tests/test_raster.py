@@ -214,3 +214,87 @@ def test_points_csv_reader(tmp_path):
     np.testing.assert_allclose(
         F.st_x(t.geometry), [-73.99, -73.98]
     )
+
+
+def test_multistrip_short_final_strip(tmp_path):
+    # hand-built striped TIFF: height 10, RowsPerStrip 4 -> strips 4,4,2
+    import struct
+
+    h, w = 10, 6
+    data = np.arange(h * w, dtype=np.uint8).reshape(h, w)
+    strips = [data[0:4], data[4:8], data[8:10]]
+    ifd_off = 8
+    ntags = 8
+    val_off = ifd_off + 2 + 12 * ntags + 4
+    offsets_blob_off = val_off
+    counts_blob_off = offsets_blob_off + 12
+    pix_off = counts_blob_off + 12
+    offs, cnts, cursor = [], [], pix_off
+    for s in strips:
+        offs.append(cursor)
+        cnts.append(s.nbytes)
+        cursor += s.nbytes
+    out = bytearray(b"II*\0" + struct.pack("<I", ifd_off))
+    out += struct.pack("<H", ntags)
+    for tag, typ, cnt, val in [
+        (256, 4, 1, w), (257, 4, 1, h), (258, 3, 1, 8), (259, 3, 1, 1),
+        (262, 3, 1, 1), (273, 4, 3, offsets_blob_off), (278, 4, 1, 4),
+        (279, 4, 3, counts_blob_off),
+    ]:
+        out += struct.pack("<HHII", tag, typ, cnt, val)
+    out += struct.pack("<I", 0)
+    out += struct.pack("<3I", *offs) + struct.pack("<3I", *cnts)
+    for s in strips:
+        out += s.tobytes()
+    p = tmp_path / "strips.tif"
+    p.write_bytes(bytes(out))
+    r = read_raster(str(p))
+    np.testing.assert_array_equal(r.data[0], data)
+
+
+def test_southup_skew_roundtrip(tmp_path):
+    # south-up + skewed geotransform must survive the checkpoint write
+    r = _toy_raster(bands=1)
+    r.gt = (100.0, 2.0, 0.5, 50.0, -0.25, 3.0)
+    p = tmp_path / "skew.tif"
+    write_geotiff(str(p), r)
+    back = read_raster(str(p))
+    np.testing.assert_allclose(back.gt, r.gt, atol=1e-12)
+
+
+def test_raster_to_grid_tile_boundary_weighted_avg(tmp_path):
+    import os
+
+    from mosaic_tpu.readers import read
+    from mosaic_tpu.core.index.h3 import H3IndexSystem
+
+    idx = H3IndexSystem()
+    r = _toy_raster(bands=1, h=16, w=16)
+    p = tmp_path / "t.tif"
+    write_geotiff(str(p), r)
+    whole = read("raster_to_grid").option("resolution", 7).option(
+        "index", idx
+    ).option("retileSize", 1024).load(str(p))
+    tiled = read("raster_to_grid").option("resolution", 7).option(
+        "index", idx
+    ).option("retileSize", 5).load(str(p))
+    assert set(whole[1]) == set(tiled[1])
+    for c, v in whole[1].items():
+        assert tiled[1][c] == pytest.approx(v, rel=1e-9)
+
+
+def test_unsupported_crs_raises():
+    r = _toy_raster(bands=1)
+    r.srid = 32767  # user-defined (e.g. sinusoidal)
+    idx = H3IndexSystem()
+    with pytest.raises(ValueError, match="SRID"):
+        F.rst_rastertogridavg([r], 7, index=idx)
+
+
+def test_lowercase_ext_listing(tmp_path):
+    from mosaic_tpu.readers import read
+
+    r = _toy_raster(bands=1)
+    write_geotiff(str(tmp_path / "a.tif"), r)  # lowercase
+    meta = read("gdal").load(str(tmp_path))
+    assert len(meta) == 1 and meta[0]["bandCount"] == 1
