@@ -19,6 +19,8 @@ No shuffle, no host round-trip: one `shard_map`-ped XLA program per step.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -72,24 +74,43 @@ def pad_index_for_shards(index: ChipIndex, shards: int) -> ChipIndex:
     if not du and not dc:
         return index
     b = index.border
+
+    def pad0(x, n, value=0):
+        widths = [(0, n)] + [(0, 0)] * (x.ndim - 1)
+        return jnp.pad(x, widths, constant_values=value)
+
     return ChipIndex(
         cells=jnp.pad(index.cells, (0, du), constant_values=_I64_MAX),
-        chip_rows=jnp.pad(index.chip_rows, ((0, du), (0, 0)), constant_values=-1),
+        chip_rows=pad0(index.chip_rows, du, -1),
         chip_geom=jnp.pad(index.chip_geom, (0, dc)),
         chip_core=jnp.pad(index.chip_core, (0, dc)),
         border=DeviceGeometry(
-            verts=jnp.pad(b.verts, ((0, dc), (0, 0), (0, 0), (0, 0))),
-            ring_len=jnp.pad(b.ring_len, ((0, dc), (0, 0))),
-            ring_is_hole=jnp.pad(b.ring_is_hole, ((0, dc), (0, 0))),
+            verts=pad0(b.verts, dc),
+            ring_len=pad0(b.ring_len, dc),
+            ring_is_hole=pad0(b.ring_is_hole, dc),
             n_rings=jnp.pad(b.n_rings, (0, dc)),
             geom_type=jnp.pad(b.geom_type, (0, dc)),
             shift=b.shift,
         ),
+        # T is a power of two >= shards, so the table needs no padding; the
+        # hash stays valid because its size is unchanged. table_slot values
+        # index the (padded) U axis, which only grew at the end.
+        hash_mult=index.hash_mult,
+        table_cell=index.table_cell,
+        table_slot=index.table_slot,
+        cell_verts=pad0(index.cell_verts, du),
+        cell_elen=pad0(index.cell_elen, du),
+        cell_core=pad0(index.cell_core, du),
+        cell_geom=pad0(index.cell_geom, du, -1),
     )
 
 
-def _index_specs(spec) -> ChipIndex:
-    """A ChipIndex-shaped pytree of PartitionSpecs (shift stays replicated)."""
+def _index_specs(spec, table_spec) -> ChipIndex:
+    """A ChipIndex-shaped pytree of PartitionSpecs (shift stays replicated).
+
+    ``table_spec`` covers the hash-table leaves: P(axis) when the shard
+    count divides T (a power of two), P() (replicated) otherwise.
+    """
     return ChipIndex(
         cells=spec,
         chip_rows=spec,
@@ -103,33 +124,37 @@ def _index_specs(spec) -> ChipIndex:
             geom_type=spec,
             shift=P(),
         ),
+        hash_mult=P(),
+        table_cell=table_spec,
+        table_slot=table_spec,
+        cell_verts=spec,
+        cell_elen=spec,
+        cell_core=spec,
+        cell_geom=spec,
     )
 
 
-def _gather_index(idx: ChipIndex, axis_name: str) -> ChipIndex:
-    """All-gather every sharded leaf of the chip index over ``axis_name``.
+def _gather_index(idx: ChipIndex, axis_name: str, table_sharded: bool) -> ChipIndex:
+    """All-gather the PROBE leaves of the chip index over ``axis_name``.
 
     Leading-axis shards were contiguous, so tiled all-gather reassembles the
-    arrays in their original row order and chip-row ids stay valid.
+    arrays in their original row order and table_slot entries stay valid.
+    Legacy per-chip leaves (cells/chip_rows/chip_geom/chip_core/border) are
+    not read by the probe, so they pass through sharded — no ICI traffic or
+    replicated HBM is spent on them.
     """
 
     def g(x):
         return lax.all_gather(x, axis_name, axis=0, tiled=True)
 
-    b = idx.border
-    return ChipIndex(
-        cells=g(idx.cells),
-        chip_rows=g(idx.chip_rows),
-        chip_geom=g(idx.chip_geom),
-        chip_core=g(idx.chip_core),
-        border=DeviceGeometry(
-            verts=g(b.verts),
-            ring_len=g(b.ring_len),
-            ring_is_hole=g(b.ring_is_hole),
-            n_rings=g(b.n_rings),
-            geom_type=g(b.geom_type),
-            shift=b.shift,
-        ),
+    return dataclasses.replace(
+        idx,
+        table_cell=g(idx.table_cell) if table_sharded else idx.table_cell,
+        table_slot=g(idx.table_slot) if table_sharded else idx.table_slot,
+        cell_verts=g(idx.cell_verts),
+        cell_elen=g(idx.cell_elen),
+        cell_core=g(idx.cell_core),
+        cell_geom=g(idx.cell_geom),
     )
 
 
@@ -150,7 +175,7 @@ def distributed_join_step(mesh: Mesh, num_zones: int):
     index_spec = _index_specs(P("cell"))
 
     def step(points, pcells, index):
-        full = _gather_index(index, "cell")
+        full = _gather_index(index, "cell", table_sharded=True)
         match = pip_join_points(points, pcells, full)
         zone = jnp.where(match >= 0, match, num_zones).astype(jnp.int32)
         counts = jax.ops.segment_sum(

@@ -82,7 +82,9 @@ class MosaicFrame:
             from ..context import current_context
 
             index = current_context().index_system
-        res = resolution or self.resolution or self.get_optimal_resolution(index)
+        res = resolution if resolution is not None else self.resolution
+        if res is None:
+            res = self.get_optimal_resolution(index)
         pts = np.stack(
             [
                 _point_coords(points.geometry, 0),

@@ -7,15 +7,24 @@ join is an equi-join on cell id, and the exact `st_contains` predicate runs
 only on border-chip matches (`is_core || st_contains(wkb, point)`).
 
 TPU-native redesign: there is no shuffle. The chip table is compiled into a
-device-resident :class:`ChipIndex` — a sorted cell-id vector plus a dense
-``(U, M)`` slot table of chip rows — which is small enough to replicate
+device-resident :class:`ChipIndex` which is small enough to replicate
 (all-gather over ICI) on every chip of a mesh, while the billion-point side
-shards over devices. Per point the join is then:
+shards over devices.
 
-    searchsorted(cells, point_cell) → slot row → M candidate chips
-    hit = chip_is_core | ray_crossing(point, chip_polygon)
+The per-point probe is designed around TPU gather latency (random HBM row
+gathers are latency-bound at ~tens of ns each, independent of row size):
 
-which is one fused XLA program: no host round-trip, no dynamic shapes.
+    key = (cell * A) >> (64 - log2 T)      multiply-shift hash, no search
+    bucket = table[key]                     1 gather: B candidate (cell, u)
+    u      = bucket row whose cell matches  parallel compare, no loop
+    chips  = cell_rows[u]                   1 WIDE gather: all M chips' edge
+                                            data, core flags and geom ids
+    hit    = core | ray_crossing(...)       fused vector math
+
+Two parallel gathers per point, total — versus the 13 serially-dependent
+gathers of a binary search (searchsorted) plus ~3M small per-chip gathers,
+which measured ~10x slower on v5e. Everything is one fused XLA program: no
+host round-trip, no dynamic shapes.
 """
 
 from __future__ import annotations
@@ -27,7 +36,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.geometry.device import DeviceGeometry, pack_to_device
-from ..core.geometry.predicates import contains_xy_gather
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
@@ -40,6 +48,8 @@ _SENTINEL = jnp.iinfo(jnp.int32).max
 class ChipIndex:
     """Device-resident join index over a tessellated polygon table.
 
+    Per-chip layout (kept for oracles, tests and host inspection):
+
     cells:     (U,) int64 — sorted unique cell ids present in the chip table.
     chip_rows: (U, M) int32 — chip-row ids per cell, -1 padded (M = max
                chips per cell, static).
@@ -47,6 +57,17 @@ class ChipIndex:
     chip_core: (C,) bool — core chips skip the predicate.
     border:    DeviceGeometry over all C chip rows (core rows are empty and
                never evaluated).
+
+    Probe fast path (see module docstring):
+
+    hash_mult:  (1,) uint64 — multiply-shift hash multiplier.
+    table_cell: (T, B) int64 — bucketed hash table of cell ids (-1 empty);
+                T is a power of two, B the max bucket occupancy.
+    table_slot: (T, B) int32 — cell slot u for each bucket entry (-1 empty).
+    cell_verts: (U, M, R, V, 2) — every cell's M chip polygons, gathered
+                into one row so the probe is a single wide gather.
+    cell_elen:  (U, M, R) int32 — ring lengths (edge masks) per chip.
+    cell_core:  (U, M) bool; cell_geom: (U, M) int32, -1 padded.
     """
 
     cells: jax.Array
@@ -54,6 +75,13 @@ class ChipIndex:
     chip_geom: jax.Array
     chip_core: jax.Array
     border: DeviceGeometry
+    hash_mult: jax.Array
+    table_cell: jax.Array
+    table_slot: jax.Array
+    cell_verts: jax.Array
+    cell_elen: jax.Array
+    cell_core: jax.Array
+    cell_geom: jax.Array
 
     @property
     def num_cells(self) -> int:
@@ -62,6 +90,35 @@ class ChipIndex:
     @property
     def max_chips_per_cell(self) -> int:
         return int(self.chip_rows.shape[1])
+
+
+def _build_hash(cells: np.ndarray, max_bucket: int = 8):
+    """Host: bucketed multiply-shift hash over the unique cell ids.
+
+    Returns (mult, table_cell (T, B), table_slot (T, B)). T is sized ~4x the
+    cell count (power of two); the multiplier is retried until the fullest
+    bucket holds <= max_bucket entries, then B shrinks to the realized max.
+    """
+    U = cells.shape[0]
+    bits = max(4, int(np.ceil(np.log2(max(4 * U, 16)))))
+    rng = np.random.default_rng(0xC0FFEE)
+    for _ in range(32):
+        mult = np.uint64(rng.integers(0, 2**64, dtype=np.uint64) | np.uint64(1))
+        keys = (cells.astype(np.uint64) * mult) >> np.uint64(64 - bits)
+        counts = np.bincount(keys.astype(np.int64), minlength=1 << bits)
+        if counts.max() <= max_bucket:
+            break
+        bits += 1  # grow the table if this multiplier clusters
+    B = int(counts.max())
+    T = 1 << bits
+    table_cell = np.full((T, B), -1, dtype=np.int64)
+    table_slot = np.full((T, B), -1, dtype=np.int32)
+    fill = np.zeros(T, dtype=np.int64)
+    for u, (c, k) in enumerate(zip(cells, keys.astype(np.int64))):
+        table_cell[k, fill[k]] = c
+        table_slot[k, fill[k]] = u
+        fill[k] += 1
+    return mult, table_cell, table_slot
 
 
 def build_chip_index(
@@ -101,16 +158,55 @@ def build_chip_index(
             else:
                 b.append_from(chips, g)
         chips = b.build()
+    # recenter: chips span a city/region, so subtracting the f64 midpoint
+    # before narrowing to f32 shrinks the coordinate ulp by ~1e3 (the
+    # SURVEY §7 precision strategy) — points are shifted to match in
+    # pip_join before they are narrowed.
+    border = pack_to_device(chips, dtype=dtype, recenter=recenter)
+
+    # probe fast path: hash table + per-cell packed chip rows
+    mult, table_cell, table_slot = _build_hash(uniq)
+    bverts = np.asarray(border.verts)
+    blen = np.asarray(border.ring_len)
+    U = uniq.size
+    _, R, V, _ = bverts.shape
+    cell_verts = np.zeros((U, M, R, V, 2), dtype=bverts.dtype)
+    cell_elen = np.zeros((U, M, R), dtype=np.int32)
+    cell_core = np.zeros((U, M), dtype=bool)
+    cell_geom = np.full((U, M), -1, dtype=np.int32)
+    valid = rows >= 0
+    rs = np.maximum(rows, 0)
+    cell_verts[:] = bverts[rs]
+    cell_verts[~valid] = 0.0
+    cell_elen[:] = blen[rs]
+    cell_elen[~valid] = 0
+    # non-polygonal chips (line/point tessellations) must contribute no
+    # edges: their rings are open, so the closed-ring edge mask would admit
+    # a phantom edge to the zero pad and flip crossing parity (same guard
+    # as predicates._poly_edges). is_core still matches them exactly.
+    from ..core.types import GeometryType
+
+    btype = np.asarray(border.geom_type)
+    poly = (btype[rs] == GeometryType.POLYGON) | (
+        btype[rs] == GeometryType.MULTIPOLYGON
+    )
+    cell_elen[~poly] = 0
+    cell_core[:] = table.is_core[rs] & valid
+    cell_geom[valid] = table.geom_id[rs[valid]].astype(np.int32)
+
     return ChipIndex(
         cells=jnp.asarray(uniq, dtype=jnp.int64),
         chip_rows=jnp.asarray(rows),
         chip_geom=jnp.asarray(table.geom_id.astype(np.int32)),
         chip_core=jnp.asarray(table.is_core),
-        # recenter: chips span a city/region, so subtracting the f64 midpoint
-        # before narrowing to f32 shrinks the coordinate ulp by ~1e3 (the
-        # SURVEY §7 precision strategy) — points are shifted to match in
-        # pip_join before they are narrowed.
-        border=pack_to_device(chips, dtype=dtype, recenter=recenter),
+        border=border,
+        hash_mult=jnp.asarray(np.asarray([mult], dtype=np.uint64)),
+        table_cell=jnp.asarray(table_cell),
+        table_slot=jnp.asarray(table_slot),
+        cell_verts=jnp.asarray(cell_verts),
+        cell_elen=jnp.asarray(cell_elen),
+        cell_core=jnp.asarray(cell_core),
+        cell_geom=jnp.asarray(cell_geom),
     )
 
 
@@ -120,21 +216,45 @@ def pip_join_points(
     """(N,) int32 — smallest matching polygon row per point, -1 if none.
 
     Jittable; shard the point axis over a mesh and replicate ``index``.
+    Probe = hash lookup (1 gather) + packed cell row (1 wide gather) + fused
+    ray crossing over (N, M, R, E) — see module docstring for why.
     """
-    U = index.cells.shape[0]
-    u = jnp.clip(jnp.searchsorted(index.cells, pcells), 0, U - 1)
-    cell_hit = index.cells[u] == pcells  # (N,)
-    rows = index.chip_rows[u]  # (N, M)
-    valid = cell_hit[:, None] & (rows >= 0)
-    rows_safe = jnp.maximum(rows, 0)
-    core = index.chip_core[rows_safe] & valid
-    N, M = rows.shape
-    flat_idx = rows_safe.reshape(-1)
-    flat_pts = jnp.repeat(points, M, axis=0)
-    inside = contains_xy_gather(flat_pts, flat_idx, index.border).reshape(N, M)
-    hit = core | (inside & valid & ~index.chip_core[rows_safe])
-    geoms = jnp.where(hit, index.chip_geom[rows_safe], _SENTINEL)
-    best = jnp.min(geoms, axis=1)
+    T = index.table_cell.shape[0]
+    shift_bits = jnp.uint64(64 - int(np.log2(T)))
+    key = (
+        (pcells.astype(jnp.uint64) * index.hash_mult[0]) >> shift_bits
+    ).astype(jnp.int32)
+    cand_cell = index.table_cell[key]  # (N, B)
+    cand_slot = index.table_slot[key]  # (N, B)
+    match = (cand_cell == pcells[:, None]) & (cand_slot >= 0)
+    u = jnp.max(jnp.where(match, cand_slot, -1), axis=1)  # (N,)
+    found = u >= 0
+    us = jnp.maximum(u, 0)
+
+    verts = index.cell_verts[us]  # (N, M, R, V, 2) — the one wide gather
+    elen = index.cell_elen[us]  # (N, M, R)
+    core = index.cell_core[us]  # (N, M)
+    geom = index.cell_geom[us]  # (N, M)
+
+    a = verts[..., :-1, :]
+    b = verts[..., 1:, :]
+    px = points[:, 0][:, None, None, None]
+    py = points[:, 1][:, None, None, None]
+    ay, by = a[..., 1], b[..., 1]
+    straddle = (ay > py) != (by > py)
+    denom = by - ay
+    denom = jnp.where(denom == 0, 1.0, denom)
+    xcross = a[..., 0] + (py - ay) * (b[..., 0] - a[..., 0]) / denom
+    emask = (
+        jnp.arange(verts.shape[3] - 1, dtype=jnp.int32)[None, None, None, :]
+        < elen[..., None]
+    )
+    crossings = jnp.sum(
+        (straddle & (px < xcross) & emask).astype(jnp.int32), axis=(-2, -1)
+    )  # (N, M)
+    inside = (crossings & 1) == 1
+    hit = found[:, None] & (geom >= 0) & (core | inside)
+    best = jnp.min(jnp.where(hit, geom, _SENTINEL), axis=1)
     return jnp.where(best == _SENTINEL, -1, best).astype(jnp.int32)
 
 

@@ -1,0 +1,87 @@
+"""Observability utilities: timing, device tracing, logging.
+
+Reference analogs: the test-only `time`/`benchmark` helpers
+(`src/test/scala/.../test/SparkSuite.scala:30-36,63-68` — median-of-trials
+wall-clock), Spark's `Logging` trait usage (`functions/MosaicContext.scala:
+28`), and the bundled `log4j.properties`. The TPU twist: `device_trace`
+hooks `jax.profiler` so hot kernels show up in a real XLA trace viewer
+dump, and `benchmark` blocks on device results so async dispatch doesn't
+fake the numbers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time as _time
+
+import jax
+
+__all__ = ["get_logger", "timer", "benchmark", "device_trace", "annotate"]
+
+_FMT = "%(asctime)s %(levelname)s %(name)s: %(message)s"
+
+
+def get_logger(name: str = "mosaic_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(_FMT))
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+    return logger
+
+
+@contextlib.contextmanager
+def timer(label: str = "", logger: "logging.Logger | None" = None):
+    """Wall-clock a block; yields a dict that gets ``seconds`` on exit."""
+    out = {"label": label}
+    t0 = _time.perf_counter()
+    try:
+        yield out
+    finally:
+        out["seconds"] = _time.perf_counter() - t0
+        (logger or get_logger()).info("%s: %.4fs", label or "block", out["seconds"])
+
+
+def benchmark(fn, *args, trials: int = 5, warmup: int = 1, **kwargs) -> dict:
+    """Median/min/mean wall-clock of ``fn`` with device-sync per trial
+    (reference: SparkSuite.benchmark, restart-per-trial)."""
+
+    def sync(r):
+        jax.tree.map(
+            lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x,
+            r,
+        )
+        return r
+
+    for _ in range(warmup):
+        sync(fn(*args, **kwargs))
+    times = []
+    for _ in range(trials):
+        t0 = _time.perf_counter()
+        sync(fn(*args, **kwargs))
+        times.append(_time.perf_counter() - t0)
+    times.sort()
+    return {
+        "trials": trials,
+        "min_s": times[0],
+        "median_s": times[len(times) // 2],
+        "mean_s": sum(times) / len(times),
+    }
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture an XLA profiler trace of the block (view with tensorboard or
+    xprof). Replaces 'look at the Spark UI' as the profiling story."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (jax.profiler.TraceAnnotation)."""
+    return jax.profiler.TraceAnnotation(name)

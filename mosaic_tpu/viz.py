@@ -1,0 +1,122 @@
+"""Map visualization: Kepler.gl when available, self-contained HTML fallback.
+
+Reference analog: the `%%mosaic_kepler` IPython magic
+(`python/mosaic/utils/kepler_magic.py:18-70`) which renders H3/BNG cells and
+chip tables on Kepler maps, with its canned config
+(`python/mosaic/utils/kepler_config.py`). keplergl is not part of this
+image, so the same entry points render to (a) a keplergl map when the
+package is importable, (b) otherwise a dependency-free HTML file that draws
+the GeoJSON on a canvas — enough to eyeball tessellations and joins.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["to_feature_collection", "plot_cells", "plot_geometries", "mosaic_kepler"]
+
+
+def to_feature_collection(geom, properties: "dict | None" = None) -> dict:
+    """Geometry column (+ parallel property columns) -> GeoJSON FC dict."""
+    from .core.geometry.geojson import to_geojson_obj
+    from .functions._coerce import to_packed
+
+    col = to_packed(geom)
+    objs = to_geojson_obj(col)
+    feats = []
+    for i, g in enumerate(objs):
+        props = {}
+        for k, v in (properties or {}).items():
+            val = v[i]
+            props[k] = val.item() if hasattr(val, "item") else val
+        feats.append({"type": "Feature", "geometry": g, "properties": props})
+    return {"type": "FeatureCollection", "features": feats}
+
+
+def plot_cells(cells, index=None, values=None, path: "str | None" = None):
+    """Render grid cells (optionally choropleth by ``values``).
+
+    The reference magic's `mosaic_kepler cells cell_id h3` path."""
+    from .functions.grid import grid_boundary
+
+    col = grid_boundary(np.asarray(cells), fmt="packed", index=index)
+    props = {"cell": [str(c) for c in np.asarray(cells)]}
+    if values is not None:
+        props["value"] = list(np.asarray(values))
+    return plot_geometries(col, properties=props, path=path)
+
+
+def plot_geometries(geom, properties=None, path: "str | None" = None):
+    """Render a geometry column; returns the kepler map object or the HTML
+    file path of the fallback renderer."""
+    fc = to_feature_collection(geom, properties)
+    try:
+        import keplergl  # noqa: F401 — optional, not in this image
+
+        m = keplergl.KeplerGl(data={"mosaic": fc}, config=_KEPLER_CONFIG)
+        if path:
+            m.save_to_html(file_name=path)
+        return m
+    except ImportError:
+        out = Path(path or "mosaic_map.html")
+        out.write_text(_fallback_html(fc))
+        return str(out)
+
+
+def mosaic_kepler(geom_or_cells, kind: str = "geometry", **kw):
+    """Loose analog of the `%%mosaic_kepler` magic's dispatch."""
+    if kind in ("h3", "bng", "cell", "cells"):
+        return plot_cells(geom_or_cells, **kw)
+    return plot_geometries(geom_or_cells, **kw)
+
+
+_KEPLER_CONFIG = {
+    "version": "v1",
+    "config": {
+        "mapState": {"latitude": 0, "longitude": 0, "zoom": 8},
+        "mapStyle": {"styleType": "dark"},
+    },
+}
+
+
+def _fallback_html(fc: dict) -> str:
+    """Self-contained canvas renderer (no network, no deps)."""
+    data = json.dumps(fc)
+    return f"""<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>mosaic_tpu map</title>
+<style>body{{margin:0;background:#111;color:#eee;font:12px sans-serif}}
+#c{{display:block}}</style></head>
+<body><canvas id="c"></canvas><div id="info" style="position:fixed;top:4px;left:6px"></div>
+<script>
+const fc = {data};
+const cv = document.getElementById('c');
+const W = cv.width = window.innerWidth, H = cv.height = window.innerHeight;
+const ctx = cv.getContext('2d');
+let xs=[], ys=[];
+function walk(c, f) {{
+  if (typeof c[0] === 'number') f(c);
+  else c.forEach(x => walk(x, f));
+}}
+fc.features.forEach(ft => walk(ft.geometry.coordinates, p => {{xs.push(p[0]); ys.push(p[1]);}}));
+const x0=Math.min(...xs), x1=Math.max(...xs), y0=Math.min(...ys), y1=Math.max(...ys);
+const s = 0.92*Math.min(W/(x1-x0||1), H/(y1-y0||1));
+const tx = x => (x-x0)*s + 0.04*W, ty = y => H - ((y-y0)*s + 0.04*H);
+const colors = ['#4cc9f0','#f72585','#b5e48c','#ffd166','#9b5de5','#00f5d4'];
+fc.features.forEach((ft, i) => {{
+  ctx.strokeStyle = colors[i % colors.length]; ctx.fillStyle = ctx.strokeStyle + '33';
+  const g = ft.geometry;
+  function ring(r) {{
+    ctx.beginPath();
+    r.forEach((p, j) => j ? ctx.lineTo(tx(p[0]), ty(p[1])) : ctx.moveTo(tx(p[0]), ty(p[1])));
+    ctx.closePath(); ctx.fill(); ctx.stroke();
+  }}
+  if (g.type === 'Polygon') g.coordinates.forEach(ring);
+  else if (g.type === 'MultiPolygon') g.coordinates.forEach(p => p.forEach(ring));
+  else if (g.type === 'LineString') {{ ctx.beginPath(); g.coordinates.forEach((p,j)=> j?ctx.lineTo(tx(p[0]),ty(p[1])):ctx.moveTo(tx(p[0]),ty(p[1]))); ctx.stroke(); }}
+  else if (g.type === 'Point') {{ ctx.beginPath(); ctx.arc(tx(g.coordinates[0]), ty(g.coordinates[1]), 2.5, 0, 7); ctx.fill(); }}
+}});
+document.getElementById('info').textContent = fc.features.length + ' features';
+</script></body></html>"""

@@ -1,0 +1,48 @@
+"""Viz fallback renderer + observability utils."""
+
+import numpy as np
+
+from mosaic_tpu import functions as F
+from mosaic_tpu import viz
+from mosaic_tpu.core.index.h3 import H3IndexSystem
+from mosaic_tpu.utils import benchmark, get_logger, timer
+
+
+def test_feature_collection_props():
+    fc = viz.to_feature_collection(
+        ["POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))", "POINT (2 2)"],
+        properties={"name": np.array(["a", "b"], dtype=object)},
+    )
+    assert fc["type"] == "FeatureCollection"
+    assert len(fc["features"]) == 2
+    assert fc["features"][0]["properties"]["name"] == "a"
+    assert fc["features"][1]["geometry"]["type"] == "Point"
+
+
+def test_plot_cells_html(tmp_path):
+    idx = H3IndexSystem()
+    cells = np.asarray(
+        F.grid_longlatascellid(np.array([-0.1, -0.2]), np.array([51.5, 51.6]), 7, index=idx)
+    )
+    out = viz.plot_cells(cells, index=idx, values=[1.0, 2.0], path=str(tmp_path / "m.html"))
+    html = (tmp_path / "m.html").read_text()
+    assert "FeatureCollection" in html and "canvas" in html
+    assert out.endswith("m.html")
+
+
+def test_mosaic_kepler_dispatch(tmp_path):
+    p = viz.mosaic_kepler(
+        ["POINT (0 0)"], kind="geometry", path=str(tmp_path / "g.html")
+    )
+    assert p.endswith("g.html")
+
+
+def test_timer_and_benchmark(caplog):
+    with timer("unit") as t:
+        sum(range(1000))
+    assert t["seconds"] >= 0
+    import jax.numpy as jnp
+
+    stats = benchmark(lambda x: jnp.sum(x * 2), jnp.arange(1000.0), trials=3)
+    assert stats["min_s"] <= stats["median_s"]
+    assert get_logger().name == "mosaic_tpu"
