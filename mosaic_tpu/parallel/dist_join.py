@@ -158,7 +158,7 @@ def _gather_index(idx: ChipIndex, axis_name: str, table_sharded: bool) -> ChipIn
     )
 
 
-def distributed_join_step(mesh: Mesh, num_zones: int):
+def distributed_join_step(mesh: Mesh, num_zones: int, table_size: int | None = None):
     """Build the jitted full distributed join+aggregate step for ``mesh``.
 
     Returns ``step(points, pcells, index) -> (match, zone_counts)`` where
@@ -168,14 +168,25 @@ def distributed_join_step(mesh: Mesh, num_zones: int):
     - ``pcells``  (N,) int64 cell ids, sharded the same way;
     - ``index``   a `pad_index_for_shards(ix, mesh.shape['cell'])` chip
       index — leading axes sharded over ``"cell"``;
+    - ``table_size``  T = ``index.table_cell.shape[0]``; the hash table is
+      sharded over ``cell`` (and all-gathered in the step) only when the
+      shard count divides T — otherwise it stays replicated, which is
+      always correct (T is a power of two, so any power-of-two cell axis
+      divides it; pass None to force replication);
     - ``match``   (N,) int32 matched polygon row (-1 none), sharded as input;
     - ``zone_counts`` (num_zones,) int64, globally psum-reduced (replicated).
     """
+    cell_shards = int(mesh.shape["cell"])
+    table_sharded = (
+        table_size is not None and cell_shards > 1 and table_size % cell_shards == 0
+    )
     point_spec = P(("dp", "cell"))
-    index_spec = _index_specs(P("cell"))
+    index_spec = _index_specs(
+        P("cell"), P("cell") if table_sharded else P()
+    )
 
     def step(points, pcells, index):
-        full = _gather_index(index, "cell", table_sharded=True)
+        full = _gather_index(index, "cell", table_sharded=table_sharded)
         match = pip_join_points(points, pcells, full)
         zone = jnp.where(match >= 0, match, num_zones).astype(jnp.int32)
         counts = jax.ops.segment_sum(
