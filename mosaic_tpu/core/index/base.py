@@ -93,6 +93,18 @@ class IndexSystem(abc.ABC):
         BNG centroid-BFS). The center test runs on device via the PIP kernel.
         """
 
+    def polyfill_candidates_batch(
+        self, bounds: np.ndarray, resolution: int
+    ) -> list[np.ndarray]:
+        """Host: candidates per bbox row of ``bounds`` (G, 4). Default loops;
+        systems with batch-friendly math override this to amortize the
+        per-call overhead across a whole geometry column."""
+        bounds = np.asarray(bounds, dtype=np.float64).reshape(-1, 4)
+        return [
+            np.asarray(self.polyfill_candidates(bounds[g], resolution))
+            for g in range(bounds.shape[0])
+        ]
+
     # ------------------------------------------------------------- strings
     @abc.abstractmethod
     def format(self, cells: np.ndarray) -> list[str]:

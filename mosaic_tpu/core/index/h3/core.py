@@ -66,6 +66,33 @@ def geo_to_cell(lat, lng, res: int, xp=np):
     rot = fijk_rot[face, i, j, k]
 
     pent = is_pent[bc]
+    if xp is np and digits.ndim == 2:
+        # host fast path: pentagons are 12 of 122 base cells — handle them
+        # on the (usually empty) subset; hexagons take one composed-table
+        # gather instead of the 5-iteration conditional rotation loop
+        prows = np.nonzero(pent)[0]
+        if prows.size:
+            dsub = digits[prows]
+            lead = hm.leading_nonzero_digit(dsub, res, np)
+            cw_off = (pent_cw[bc[prows], 0] == face[prows]) | (
+                pent_cw[bc[prows], 1] == face[prows]
+            )
+            need = lead == C.K_AXES_DIGIT
+            adj = np.where(
+                cw_off[:, None],
+                hm.rotate60_cw(dsub, res, np),
+                hm.rotate60_ccw(dsub, res, np),
+            )
+            dsub = np.where(need[:, None], adj, dsub)
+            rsub = rot[prows]
+            for n in range(1, 6):
+                rotated = hm.rotate_pent60_ccw(dsub, res, np)
+                dsub = np.where((rsub >= n)[:, None], rotated, dsub)
+        digits = hm.ROT60_CCW_POW[np.where(pent, 0, rot)[:, None], digits]
+        if prows.size:
+            digits[prows] = dsub
+        return hm.pack(bc, digits, res, np)
+
     lead = hm.leading_nonzero_digit(digits, res, xp)
     cw_off = (pent_cw[bc, 0] == face) | (pent_cw[bc, 1] == face)
     need_adjust = pent & (lead == C.K_AXES_DIGIT)

@@ -242,14 +242,12 @@ def unpack(h, xp=np):
 
 def leading_nonzero_digit(digits, res, xp=np):
     """First non-CENTER digit among digits[..., :res] (0 if none)."""
-    out = xp.zeros(digits.shape[:-1], dtype=np.int64)
-    found = xp.zeros(digits.shape[:-1], dtype=bool)
-    for r in range(C.MAX_RES):
-        d = digits[..., r]
-        active = (r < res) & ~found & (d != 0)
-        out = xp.where(active, d, out)
-        found = found | ((r < res) & (d != 0))
-    return out
+    r_idx = xp.arange(C.MAX_RES)
+    resb = xp.asarray(res)[..., None] if np.ndim(res) else res
+    nz = (digits != 0) & (r_idx < resb)
+    idx = xp.argmax(nz, axis=-1)
+    d = xp.take_along_axis(digits, idx[..., None], axis=-1)[..., 0]
+    return xp.where(nz.any(axis=-1), d, xp.zeros_like(d))
 
 
 def rotate_digits(digits, res, table, xp=np):
@@ -261,6 +259,18 @@ def rotate_digits(digits, res, table, xp=np):
         r_idx < res
     )
     return xp.where(mask, rotated, digits)
+
+
+# composed rotation powers: ROT60_CCW_POW[n] applies n ccw rotations in one
+# digit-table gather (INVALID_DIGIT 7 maps to itself, so no res mask needed)
+def _compose_rot_pow() -> np.ndarray:
+    tabs = [np.arange(8, dtype=np.int64)]
+    for _ in range(5):
+        tabs.append(C.ROT60_CCW[tabs[-1]])
+    return np.stack(tabs)
+
+
+ROT60_CCW_POW = _compose_rot_pow()  # (6, 8)
 
 
 def rotate60_ccw(digits, res, xp=np):

@@ -87,85 +87,103 @@ class H3IndexSystem(IndexSystem):
         return core.is_pentagon_cell(xp.asarray(cells), xp)
 
     # ----------------------------------------------------------- neighbors
-    def neighbors(self, cells) -> np.ndarray:
-        """(N,) -> (N, 6) adjacent cells (edge-sharing), -1 pads for
-        pentagons/duplicates.
+    def neighbors_raw(self, cells) -> np.ndarray:
+        """(N,) -> (N, 6) raw neighbor candidates — vectorized, MAY contain
+        duplicates and the cell itself (pentagon distortion); no -1s.
 
         Table-free: steps from each cell center past each edge midpoint in
         the owning face's exact grid frame, then re-rounds — the geometric
         equivalent of the C library's h3NeighborRotations tables.
         """
         xp = np
-        cells = np.asarray(cells, dtype=np.int64)
+        cells = np.asarray(cells, dtype=np.int64).reshape(-1)
         face, i, j, k, res_arr = core.cell_to_owned_fijk(cells, xp)
         cx, cy = hm.ijk_to_hex2d(
             i.astype(float), j.astype(float), k.astype(float), xp
         )
-        out = np.full((len(cells), 6), -1, dtype=np.int64)
+        N = len(cells)
+        # all 6 directions in one flattened projection/round-trip
+        ang = np.arange(6) * (np.pi / 3)
+        nx = (cx[:, None] + np.cos(ang)[None, :]).reshape(-1)  # (N*6,)
+        ny = (cy[:, None] + np.sin(ang)[None, :]).reshape(-1)
+        face6 = np.repeat(face, 6)
+        res6 = np.repeat(res_arr, 6)
+        lat, lng = core._per_res_geo(face6, nx, ny, res6, xp)
+        ncell = np.full(N * 6, -1, dtype=np.int64)
+        for r in np.unique(res6):
+            sel = res6 == r
+            ncell[sel] = core.geo_to_cell(lat[sel], lng[sel], int(r), xp)
+        return ncell.reshape(N, 6)
+
+    def neighbors(self, cells) -> np.ndarray:
+        """(N,) -> (N, 6) adjacent cells (edge-sharing), -1 pads for
+        pentagons/duplicates (first occurrence kept, order preserved)."""
+        cells = np.asarray(cells, dtype=np.int64).reshape(-1)
+        out = self.neighbors_raw(cells)
         for m in range(6):
-            ang = m * np.pi / 3
-            nx = cx + np.cos(ang)
-            ny = cy + np.sin(ang)
-            lat, lng = core._per_res_geo(face, nx, ny, res_arr, xp)
-            ncell = np.full(len(cells), -1, dtype=np.int64)
-            for r in np.unique(res_arr):
-                sel = res_arr == r
-                ncell[sel] = core.geo_to_cell(lat[sel], lng[sel], int(r), xp)
-            out[:, m] = ncell
-        # dedupe per row (pentagon neighbors can repeat), drop self
-        for row in range(out.shape[0]):
-            seen = {int(cells[row])}
-            for m in range(6):
-                v = int(out[row, m])
-                if v in seen:
-                    out[row, m] = -1
-                else:
-                    seen.add(v)
+            dup = out[:, m] == cells
+            if m:
+                dup |= (out[:, m : m + 1] == out[:, :m]).any(axis=1)
+            out[dup, m] = -1
         return out
+
+    @staticmethod
+    def _row_unique(a: np.ndarray, width: int | None = None) -> np.ndarray:
+        """Per-row sorted unique of an int64 array; -1 entries dropped,
+        result left-packed ascending and -1-padded to ``width`` columns."""
+        big = np.iinfo(np.int64).max
+        s = np.sort(np.where(a < 0, big, a), axis=1)
+        dup = np.zeros_like(s, dtype=bool)
+        dup[:, 1:] = s[:, 1:] == s[:, :-1]
+        s[dup] = big
+        s = np.sort(s, axis=1)
+        used = int((s != big).sum(axis=1).max()) if s.size else 0
+        w = max(width if width is not None else used, 1)
+        if s.shape[1] < w:
+            s = np.pad(s, ((0, 0), (0, w - s.shape[1])), constant_values=big)
+        s = s[:, :w]
+        return np.where(s == big, np.int64(-1), s)
 
     def k_ring(self, cells, k: int) -> np.ndarray:
-        """(N,) -> (N, 1+3k(k+1)) filled disk (host BFS over neighbors)."""
-        cells = np.asarray(cells, dtype=np.int64)
+        """(N,) -> (N, 1+3k(k+1)) filled disk, sorted ascending, -1 pads.
+
+        Vectorized level-wise expansion: each round takes raw neighbors of
+        the whole current disk in ONE batched call and row-uniques — no
+        per-row Python sets (reference does this in C via JNI,
+        `core/index/H3IndexSystem.scala:152-166`)."""
+        cells = np.asarray(cells, dtype=np.int64).reshape(-1)
+        N = cells.shape[0]
         m_out = 1 + 3 * k * (k + 1)
-        disk = [set([int(c)]) for c in cells]
-        frontier = cells.copy()
-        frontier_sets = [set([int(c)]) for c in cells]
+        disk = cells[:, None].copy()
+        if N == 0 or k == 0:
+            return self._row_unique(disk, width=m_out)
         for _ in range(k):
-            next_sets = [set() for _ in cells]
-            flat = sorted({c for s in frontier_sets for c in s})
-            if not flat:
-                break
-            flat_arr = np.asarray(flat, dtype=np.int64)
-            nbrs = self.neighbors(flat_arr)
-            nbr_map = {int(c): nbrs[i] for i, c in enumerate(flat_arr)}
-            for row in range(len(cells)):
-                for c in frontier_sets[row]:
-                    for v in nbr_map[c]:
-                        v = int(v)
-                        if v >= 0 and v not in disk[row]:
-                            next_sets[row].add(v)
-                disk[row] |= next_sets[row]
-            frontier_sets = next_sets
-        out = np.full((len(cells), m_out), -1, dtype=np.int64)
-        for row in range(len(cells)):
-            vals = sorted(disk[row])
-            out[row, : len(vals)] = vals[:m_out]
-        return out
+            # -1 pads would corrupt the geometric step: substitute each
+            # row's own center (its neighbors are already in the disk)
+            safe = np.where(disk >= 0, disk, disk[:, :1])
+            nb = self.neighbors_raw(safe.reshape(-1)).reshape(N, -1)
+            disk = self._row_unique(np.concatenate([disk, nb], axis=1))
+        return self._row_unique(disk, width=m_out)
 
     def k_loop(self, cells, k: int) -> np.ndarray:
-        """Hollow ring: k_ring(k) minus k_ring(k-1)."""
-        cells = np.asarray(cells, dtype=np.int64)
+        """Hollow ring: k_ring(k) minus k_ring(k-1); sorted, -1 pads."""
+        cells = np.asarray(cells, dtype=np.int64).reshape(-1)
         full = self.k_ring(cells, k)
         if k == 0:
             return full
         inner = self.k_ring(cells, k - 1)
         m_out = 6 * k
-        out = np.full((len(cells), m_out), -1, dtype=np.int64)
-        for row in range(len(cells)):
-            inn = set(int(v) for v in inner[row] if v >= 0)
-            vals = [int(v) for v in full[row] if v >= 0 and int(v) not in inn]
-            out[row, : len(vals)] = vals[:m_out]
-        return out
+        # membership test: both sides sorted per row; chunk the broadcast
+        N = full.shape[0]
+        keep = np.zeros_like(full, dtype=bool)
+        chunk = max(1, int(2e7 // max(full.shape[1] * inner.shape[1], 1)))
+        for s in range(0, N, chunk):
+            sl = slice(s, s + chunk)
+            keep[sl] = (full[sl] >= 0) & ~(
+                full[sl][:, :, None] == inner[sl][:, None, :]
+            ).any(axis=2)
+        out = np.where(keep, full, np.int64(-1))
+        return self._row_unique(out, width=m_out)
 
     def grid_distance(self, cells_a, cells_b) -> np.ndarray:
         """Hex grid distance via planar ijk on a common face projection.
@@ -199,8 +217,11 @@ class H3IndexSystem(IndexSystem):
         return out
 
     # ------------------------------------------------------------ polyfill
-    def polyfill_candidates(self, bounds: np.ndarray, resolution: int) -> np.ndarray:
-        """Sample-grid candidates covering a lng/lat bbox, plus a 1-ring."""
+    def _bbox_sample_points(
+        self, bounds: np.ndarray, resolution: int
+    ) -> np.ndarray:
+        """(M, 2) lng/lat sample lattice covering one bbox densely enough
+        that every cell intersecting it is hit or is a neighbor of a hit."""
         rad = np.degrees(_cell_radius_rad(resolution))
         lat_mid = np.clip((bounds[1] + bounds[3]) / 2, -89.0, 89.0)
         step_lat = max(rad * 0.8, 1e-7)
@@ -209,12 +230,40 @@ class H3IndexSystem(IndexSystem):
         ys = np.arange(bounds[1] - step_lat, bounds[3] + 2 * step_lat, step_lat)
         ys = ys[(ys >= -90) & (ys <= 90)]
         gx, gy = np.meshgrid(xs, ys, indexing="ij")
-        pts = np.stack([gx.ravel(), gy.ravel()], axis=-1)
+        return np.stack([gx.ravel(), gy.ravel()], axis=-1)
+
+    def polyfill_candidates(self, bounds: np.ndarray, resolution: int) -> np.ndarray:
+        """Sample-grid candidates covering a lng/lat bbox, plus a 1-ring."""
+        pts = self._bbox_sample_points(np.asarray(bounds, dtype=np.float64), resolution)
         if pts.size == 0:
             return np.zeros(0, np.int64)
         cells = np.unique(self.point_to_cell(pts, resolution))
-        ring = self.k_ring(cells, 1)
-        return np.unique(ring[ring >= 0])
+        nb = self.neighbors_raw(cells)
+        return np.unique(np.concatenate([cells, nb.reshape(-1)]))
+
+    def polyfill_candidates_batch(
+        self, bounds: np.ndarray, resolution: int
+    ) -> list[np.ndarray]:
+        """Batched `polyfill_candidates` over (G, 4) bboxes in TWO fused
+        array calls total (one point->cell, one neighbor step) instead of
+        2G — the per-geometry overhead dominates tessellation otherwise."""
+        bounds = np.asarray(bounds, dtype=np.float64).reshape(-1, 4)
+        G = bounds.shape[0]
+        pts_list = [self._bbox_sample_points(bounds[g], resolution) for g in range(G)]
+        sizes = np.asarray([p.shape[0] for p in pts_list], dtype=np.int64)
+        if sizes.sum() == 0:
+            return [np.zeros(0, np.int64) for _ in range(G)]
+        pts = np.concatenate([p for p in pts_list if p.size])
+        gid = np.repeat(np.arange(G), sizes)
+        cells = np.asarray(self.point_to_cell(pts, resolution))
+        # unique (gid, cell) pairs, then ONE neighbor expansion for all
+        pair = np.unique(np.stack([gid, cells], axis=1), axis=0)
+        nb = self.neighbors_raw(pair[:, 1])  # (P, 6)
+        all_gid = np.concatenate([pair[:, 0], np.repeat(pair[:, 0], 6)])
+        all_cell = np.concatenate([pair[:, 1], nb.reshape(-1)])
+        pair2 = np.unique(np.stack([all_gid, all_cell], axis=1), axis=0)
+        split = np.searchsorted(pair2[:, 0], np.arange(G + 1))
+        return [pair2[split[g] : split[g + 1], 1] for g in range(G)]
 
     # ------------------------------------------------------------- strings
     def format(self, cells: np.ndarray) -> list[str]:

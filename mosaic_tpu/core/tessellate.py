@@ -173,6 +173,195 @@ def _dedupe_boundary(bnd: np.ndarray) -> np.ndarray:
     return cell
 
 
+def _dedupe_boundaries_batch(
+    bnds: np.ndarray, atol: float = 1e-14
+) -> tuple[np.ndarray, np.ndarray]:
+    """Batched `_dedupe_boundary`: (K, B, 2) padded boundaries →
+    (cells (K, L, 2) CCW-oriented left-packed, klen (K,)).
+
+    Index-system boundaries arrive padded by repeating vertices (closing
+    vertex and/or trailing repeats), so consecutive-duplicate removal plus
+    dropping the trailing run equal to vertex 0 reproduces the scalar
+    helper's output for every real grid boundary.
+    """
+    K, B, _ = bnds.shape
+    if K == 0:
+        return np.zeros((0, 1, 2)), np.zeros(0, dtype=np.int64)
+    diff = np.abs(bnds - np.roll(bnds, 1, axis=1)).max(axis=2) > atol  # (K,B)
+    diff[:, 0] = True
+    eq_first = np.abs(bnds - bnds[:, :1]).max(axis=2) <= atol  # (K,B)
+    trailing = np.cumprod(eq_first[:, ::-1], axis=1)[:, ::-1].astype(bool)
+    trailing[:, 0] = False
+    keep = diff & ~trailing
+    klen = keep.sum(axis=1).astype(np.int64)
+    L = int(klen.max())
+    cells = np.zeros((K, L, 2))
+    pos = np.cumsum(keep, axis=1) - 1
+    kk, jj = np.nonzero(keep)
+    cells[kk, pos[kk, jj]] = bnds[kk, jj]
+    # orient CCW: masked shoelace over the first klen vertices of each row
+    idx = np.arange(L)[None, :]
+    nxt = np.where(idx + 1 < klen[:, None], idx + 1, 0)
+    nxt_xy = np.take_along_axis(cells, nxt[:, :, None], axis=1)
+    valid = idx < klen[:, None]
+    area2 = np.sum(
+        np.where(
+            valid,
+            cells[:, :, 0] * nxt_xy[:, :, 1] - nxt_xy[:, :, 0] * cells[:, :, 1],
+            0.0,
+        ),
+        axis=1,
+    )
+    flip = area2 < 0
+    if flip.any():
+        rev = np.where(
+            idx < klen[:, None], klen[:, None] - 1 - idx, idx
+        )  # reverse the valid prefix, keep pad slots in place
+        reversed_cells = np.take_along_axis(cells, rev[:, :, None], axis=1)
+        cells = np.where(flip[:, None, None], reversed_cells, cells)
+    return cells, klen
+
+
+def _classify_cells_batch(
+    rings: list[tuple[np.ndarray, bool, int]],
+    cells: np.ndarray,
+    klen: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Batched `_classify_cells` over padded cell boundaries.
+
+    cells (K, L, 2) left-packed convex CCW boundaries, klen (K,) valid
+    vertex counts. Returns (is_core (K,), is_border (K,)). Same contract as
+    the scalar version: core ⇔ all corners inside AND no edge crossing AND
+    no geometry vertex strictly inside; border ⇔ any contact or center in.
+    """
+    K, L, _ = cells.shape
+    ring_arrays = [r for r, _, _ in rings]
+    gverts = np.concatenate(ring_arrays) if ring_arrays else np.zeros((0, 2))
+    ea, eb = [], []
+    for r in ring_arrays:
+        if r.shape[0] >= 2:
+            ea.append(r)
+            eb.append(np.roll(r, -1, axis=0))
+    ga = np.concatenate(ea) if ea else np.zeros((0, 2))
+    gb = np.concatenate(eb) if eb else np.zeros((0, 2))
+
+    idx = np.arange(L)[None, :]
+    jmask = idx < klen[:, None]  # (K, L) valid vertices == valid edges
+    corners_in = _even_odd_inside(cells.reshape(-1, 2), ring_arrays).reshape(K, L)
+    all_in = np.all(corners_in | ~jmask, axis=1)
+    any_in = np.any(corners_in & jmask, axis=1)
+    centers = cells.sum(axis=1) / klen[:, None]
+    centers_in = _even_odd_inside(centers, ring_arrays)
+
+    nxt = np.where(idx + 1 < klen[:, None], idx + 1, 0)
+    cb = np.take_along_axis(cells, nxt[:, :, None], axis=1)  # (K, L, 2)
+    d = cb - cells
+
+    vin = np.zeros(K, dtype=bool)
+    crossing = np.zeros(K, dtype=bool)
+    M = gverts.shape[0]
+    E = ga.shape[0]
+    # chunk over cells so the (K, L, M) / (E, K*L) intermediates stay bounded
+    chunk = max(1, int(2e7 // max(L * max(M, E), 1)))
+    for s in range(0, K, chunk):
+        sl = slice(s, s + chunk)
+        if M:
+            sgn = d[sl, :, 0, None] * (
+                gverts[None, None, :, 1] - cells[sl, :, 1, None]
+            ) - d[sl, :, 1, None] * (gverts[None, None, :, 0] - cells[sl, :, 0, None])
+            strict = np.all((sgn > _EPS) | ~jmask[sl, :, None], axis=1)  # (k, M)
+            vin[sl] = strict.any(axis=1)
+        if E:
+            ca_f = cells[sl].reshape(-1, 2)
+            cb_f = cb[sl].reshape(-1, 2)
+            cm = _segments_cross(ga, gb, ca_f, cb_f)  # (E, k*L)
+            cm &= jmask[sl].reshape(-1)[None, :]
+            crossing[sl] = cm.any(axis=0).reshape(-1, L).any(axis=1)
+
+    is_core = all_in & ~crossing & ~vin
+    is_border = ~is_core & (any_in | crossing | vin | centers_in)
+    return is_core, is_border
+
+
+def clip_rings_convex_batch(
+    ring: np.ndarray, cells: np.ndarray, klen: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Batched Sutherland–Hodgman: clip one open ring (n, 2) against K
+    convex CCW cell windows at once.
+
+    cells (K, L, 2) left-packed, klen (K,). Returns (out (K, C, 2), olen
+    (K,)) — clipped rings, open form, olen=0 where the clip is empty
+    (< 3 vertices). Equivalent to per-cell `clip_ring_convex` up to
+    consecutive-duplicate vertices, which are removed at the end.
+    """
+    K, L, _ = cells.shape
+    n = ring.shape[0]
+    if K == 0 or n == 0:
+        return np.zeros((K, 1, 2)), np.zeros(K, dtype=np.int64)
+    # concave rings can emit 2 points per vertex against one half-plane, so
+    # there is no small static bound; the buffer grows to each round's true
+    # need (new_len.max()) below
+    cur = np.zeros((K, n + L + 2, 2))
+    cur[:, :n] = ring[None, :, :]
+    clen = np.full(K, n, dtype=np.int64)
+    for e in range(L):
+        jdx = np.arange(cur.shape[1])[None, :]
+        active = (e < klen) & (clen > 0)
+        if not active.any():
+            break
+        ei = np.minimum(e, klen - 1)
+        a = np.take_along_axis(cells, ei[:, None, None].repeat(2, 2), axis=1)[:, 0]
+        bi = np.where(e + 1 < klen, e + 1, 0)
+        b = np.take_along_axis(cells, bi[:, None, None].repeat(2, 2), axis=1)[:, 0]
+        dx = (b[:, 0] - a[:, 0])[:, None]  # (K,1)
+        dy = (b[:, 1] - a[:, 1])[:, None]
+        s_cur = dx * (cur[:, :, 1] - a[:, 1][:, None]) - dy * (
+            cur[:, :, 0] - a[:, 0][:, None]
+        )  # (K, C)
+        nxt = np.where(jdx + 1 < clen[:, None], jdx + 1, 0)
+        nxt_xy = np.take_along_axis(cur, nxt[:, :, None], axis=1)
+        s_nxt = np.take_along_axis(s_cur, nxt, axis=1)
+        valid = jdx < clen[:, None]
+        inside_cur = s_cur >= -_EPS
+        inside_nxt = s_nxt >= -_EPS
+        denom = s_cur - s_nxt
+        denom = np.where(np.abs(denom) < _EPS, 1.0, denom)
+        t = np.clip(s_cur / denom, 0.0, 1.0)[:, :, None]
+        inter = cur + t * (nxt_xy - cur)  # (K, C, 2)
+        emit0 = valid & inside_cur & active[:, None]
+        emit1 = valid & (inside_cur != inside_nxt) & active[:, None]
+        cnt = emit0.astype(np.int64) + emit1.astype(np.int64)
+        base = np.cumsum(cnt, axis=1) - cnt  # exclusive
+        new_len = cnt.sum(axis=1)
+        W = max(int(new_len.max()), cur.shape[1], 1)
+        buf = np.zeros((K, W, 2))
+        k0, j0 = np.nonzero(emit0)
+        buf[k0, base[k0, j0]] = cur[k0, j0]
+        k1, j1 = np.nonzero(emit1)
+        buf[k1, base[k1, j1] + emit0[k1, j1]] = inter[k1, j1]
+        if W > cur.shape[1]:
+            cur = np.pad(cur, ((0, 0), (0, W - cur.shape[1]), (0, 0)))
+        cur = np.where(active[:, None, None], buf, cur)
+        clen = np.where(active, new_len, clen)
+    jdx = np.arange(cur.shape[1])[None, :]
+    # drop consecutive duplicates (cyclic), matching the scalar clipper
+    prev = np.where(jdx - 1 >= 0, jdx - 1, np.maximum(clen[:, None] - 1, 0))
+    prev_xy = np.take_along_axis(cur, prev[:, :, None], axis=1)
+    dist = np.linalg.norm(cur - prev_xy, axis=2)
+    keepv = (dist > 1e-13) & (jdx < clen[:, None])
+    # fully-degenerate rings would drop every vertex; keep one (scalar
+    # clipper's `out[:1]` fallback) so downstream length checks see it
+    all_dropped = ~keepv.any(axis=1) & (clen > 0)
+    keepv[:, 0] |= all_dropped
+    olen = keepv.sum(axis=1).astype(np.int64)
+    pos = np.cumsum(keepv, axis=1) - 1
+    out = np.zeros_like(cur)
+    kk, jj = np.nonzero(keepv)
+    out[kk, pos[kk, jj]] = cur[kk, jj]
+    olen = np.where(olen >= 3, olen, 0)
+    return out, olen
+
+
 def clip_ring_convex(ring: np.ndarray, cell: np.ndarray) -> np.ndarray:
     """Sutherland–Hodgman: clip ``ring`` (n,2, open) to convex CCW ``cell``.
 
@@ -314,8 +503,9 @@ def _classify_cells(
 def _polygon_chips(
     col: PackedGeometry,
     g: int,
-    index: IndexSystem,
-    resolution: int,
+    cand: np.ndarray,
+    cells: np.ndarray,
+    klen: np.ndarray,
     keep_core_geoms: bool,
     out_geom_id: list,
     out_cell: list,
@@ -323,17 +513,21 @@ def _polygon_chips(
     out_hasgeom: list,
     builder: GeometryBuilder,
 ) -> None:
+    """Chip one polygon geometry given its pre-batched candidate cells
+    (``cand`` ids with deduped boundaries ``cells``/``klen``)."""
     rings = _geom_rings(col, g)
-    bounds = col.bounds()[g]
-    cand = np.asarray(index.polyfill_candidates(bounds, resolution))
+    ok = klen >= 3
+    cand, cells, klen = cand[ok], cells[ok], klen[ok]
     if cand.size == 0:
         return
-    bnds = np.asarray(index.cell_boundary(cand), dtype=np.float64)
-    cells_xy = [_dedupe_boundary(bnds[i]) for i in range(len(cand))]
-    ok = np.asarray([c.shape[0] >= 3 for c in cells_xy])
-    cand, cells_xy = cand[ok], [c for c, o in zip(cells_xy, ok) if o]
-    is_core, is_border = _classify_cells(rings, cells_xy)
+    is_core, is_border = _classify_cells_batch(rings, cells, klen)
     srid = int(col.srid[g])
+    # clip every source ring against ALL border cells at once
+    bpos = np.cumsum(is_border) - 1  # border-batch position per cell row
+    bcells, bklen = cells[is_border], klen[is_border]
+    ring_clips = [
+        clip_rings_convex_batch(ring, bcells, bklen) for ring, _, _ in rings
+    ]
     for k in range(len(cand)):
         if is_core[k]:
             out_geom_id.append(g)
@@ -341,23 +535,26 @@ def _polygon_chips(
             out_core.append(True)
             out_hasgeom.append(keep_core_geoms)
             if keep_core_geoms:
-                builder.add_geometry(GeometryType.POLYGON, [[cells_xy[k]]], srid)
+                builder.add_geometry(
+                    GeometryType.POLYGON, [[cells[k, : klen[k]]]], srid
+                )
             else:
                 builder.add_geometry(GeometryType.POLYGON, [[np.zeros((0, 2))]], srid)
         elif is_border[k]:
-            # clip every part separately; keep nonempty shells with their holes
+            # assemble clipped parts; keep nonempty shells with their holes
+            t = int(bpos[k])
             parts_out = []
             cur_part = None
             cur_rings: list[np.ndarray] = []
-            for ring, is_hole, part in rings:
+            for (ring, is_hole, part), (cv, cl) in zip(rings, ring_clips):
                 if part != cur_part:
                     if cur_rings:
                         parts_out.append(cur_rings)
                     cur_part, cur_rings = part, []
-                clipped = clip_ring_convex(ring, cells_xy[k])
-                if clipped.shape[0] >= 3:
+                m = int(cl[t])
+                if m >= 3:
                     if not is_hole or cur_rings:
-                        cur_rings.append(clipped)
+                        cur_rings.append(cv[t, :m])
                     # hole with no surviving shell: cell inside hole — but
                     # then it would not be border; skip defensively
             if cur_rings:
@@ -379,6 +576,7 @@ def _line_chips(
     g: int,
     index: IndexSystem,
     resolution: int,
+    bounds: np.ndarray,
     out_geom_id: list,
     out_cell: list,
     out_core: list,
@@ -388,17 +586,17 @@ def _line_chips(
     """Reference analog: BFS `lineDecompose` (`core/Mosaic.scala:146-194`) —
     here: candidate cells over the bbox, clip the line to each, keep cells
     with nonempty clip. Line chips are never core."""
-    bounds = col.bounds()[g]
     cand = np.asarray(index.polyfill_candidates(bounds, resolution))
     if cand.size == 0:
         return
     bnds = np.asarray(index.cell_boundary(cand), dtype=np.float64)
+    cells_b, klen_b = _dedupe_boundaries_batch(bnds)
     srid = int(col.srid[g])
     parts = [col.ring_xy(r) for p in col.geom_parts(g) for r in col.part_rings(p)]
     for k in range(len(cand)):
-        cell = _dedupe_boundary(bnds[k])
-        if cell.shape[0] < 3:
+        if klen_b[k] < 3:
             continue
+        cell = cells_b[k, : klen_b[k]]
         runs: list[np.ndarray] = []
         for pts in parts:
             runs.extend(clip_segments_convex(pts, cell))
@@ -458,17 +656,62 @@ def tessellate(
     core: list[bool] = []
     hasgeom: list[bool] = []
     builder = GeometryBuilder()
+    bounds = col.bounds()
+    bases = [col.geometry_type(g).base for g in range(len(col))]
+    # batch the index-system work for ALL polygons up front: candidates in
+    # one fused call, then one cell_boundary + dedupe over every candidate
+    poly_ids = [g for g in range(len(col)) if bases[g] == GeometryType.POLYGON]
+    cand_of: dict[int, np.ndarray] = {}
+    cells_of: dict[int, np.ndarray] = {}
+    klen_of: dict[int, np.ndarray] = {}
+    if poly_ids:
+        cand_lists = index.polyfill_candidates_batch(bounds[poly_ids], resolution)
+        sizes = [c.shape[0] for c in cand_lists]
+        if sum(sizes):
+            all_cand = np.concatenate(cand_lists)
+            bnds = np.asarray(index.cell_boundary(all_cand), dtype=np.float64)
+            cells_all, klen_all = _dedupe_boundaries_batch(bnds)
+            off = np.cumsum([0] + sizes)
+            for t, g in enumerate(poly_ids):
+                sl = slice(off[t], off[t + 1])
+                cand_of[g] = cand_lists[t]
+                cells_of[g] = cells_all[sl]
+                klen_of[g] = klen_all[sl]
+    empty = (np.zeros(0, np.int64), np.zeros((0, 1, 2)), np.zeros(0, np.int64))
     for g in range(len(col)):
-        base = col.geometry_type(g).base
-        args = (col, g, index, resolution)
+        base = bases[g]
         if base == GeometryType.POLYGON:
+            cand = cand_of.get(g, empty[0])
             _polygon_chips(
-                *args, keep_core_geoms, geom_id, cell, core, hasgeom, builder
+                col,
+                g,
+                cand,
+                cells_of.get(g, empty[1]),
+                klen_of.get(g, empty[2]),
+                keep_core_geoms,
+                geom_id,
+                cell,
+                core,
+                hasgeom,
+                builder,
             )
         elif base == GeometryType.LINESTRING:
-            _line_chips(*args, geom_id, cell, core, hasgeom, builder)
+            _line_chips(
+                col,
+                g,
+                index,
+                resolution,
+                bounds[g],
+                geom_id,
+                cell,
+                core,
+                hasgeom,
+                builder,
+            )
         elif base == GeometryType.POINT:
-            _point_chips(*args, geom_id, cell, core, hasgeom, builder)
+            _point_chips(
+                col, g, index, resolution, geom_id, cell, core, hasgeom, builder
+            )
         else:
             raise ValueError(f"cannot tessellate geometry type {base}")
     return ChipTable(

@@ -224,15 +224,28 @@ class PackedGeometry:
 
     # ------------------------------------------------------------------ bbox
     def bounds(self) -> np.ndarray:
-        """(G, 4) [xmin, ymin, xmax, ymax] per geometry (NaN for empties)."""
-        out = np.full((len(self), 4), np.nan)
-        for g in range(len(self)):
-            pts = self.geom_xy(g)
-            if pts.shape[0]:
-                out[g, 0] = pts[:, 0].min()
-                out[g, 1] = pts[:, 1].min()
-                out[g, 2] = pts[:, 0].max()
-                out[g, 3] = pts[:, 1].max()
+        """(G, 4) [xmin, ymin, xmax, ymax] per geometry (NaN for empties).
+
+        Vertices are CSR-contiguous per geometry, so the per-geometry
+        min/max is one ``reduceat`` over the shared vertex buffer."""
+        G = len(self)
+        out = np.full((G, 4), np.nan)
+        if G == 0 or self.xy.shape[0] == 0:
+            return out
+        vert_bounds = self.ring_offsets[self.part_offsets[self.geom_offsets]]
+        starts, ends = vert_bounds[:-1], vert_bounds[1:]
+        nonempty = ends > starts
+        if not nonempty.any():
+            return out
+        # reduceat over nonempty starts only: empties hold no vertices, so
+        # each nonempty segment runs exactly to the next nonempty start (or
+        # the buffer end), never truncating its own vertices
+        idx_ne = np.nonzero(nonempty)[0]
+        starts_ne = starts[idx_ne]
+        mins = np.minimum.reduceat(self.xy, starts_ne, axis=0)
+        maxs = np.maximum.reduceat(self.xy, starts_ne, axis=0)
+        out[idx_ne, 0:2] = mins
+        out[idx_ne, 2:4] = maxs
         return out
 
     # ------------------------------------------------------------- selection

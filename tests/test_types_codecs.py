@@ -101,6 +101,22 @@ def test_bounds():
     np.testing.assert_allclose(b[1], [0, 0, 10, 10])
 
 
+def test_bounds_trailing_empty():
+    # regression: an empty geometry after a nonempty one must not truncate
+    # the nonempty segment's reduceat range (last vertex is often extremal)
+    col = wkt.from_wkt(["LINESTRING (0 0, 1 1, 5 5)", "POLYGON EMPTY"])
+    b = col.bounds()
+    np.testing.assert_allclose(b[0], [0, 0, 5, 5])
+    assert np.isnan(b[1]).all()
+    # empty between nonempties
+    col = wkt.from_wkt(
+        ["POLYGON EMPTY", "LINESTRING (2 3, -1 7)", "POLYGON EMPTY"]
+    )
+    b = col.bounds()
+    assert np.isnan(b[0]).all() and np.isnan(b[2]).all()
+    np.testing.assert_allclose(b[1], [-1, 3, 2, 7])
+
+
 def test_feature_collection(tmp_path):
     fc = {
         "type": "FeatureCollection",
