@@ -174,7 +174,18 @@ def read_feature_collection(path_or_obj) -> tuple[PackedGeometry, "list[dict]"]:
     """
     if isinstance(path_or_obj, (str,)):
         with open(path_or_obj) as f:
-            obj = json.load(f)
+            text = f.read()
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            # newline-delimited GeoJSON (GeoJSONSeq / NDJSON): one feature
+            # per line — e.g. the reference's NYC_Taxi_Zones.geojson fixture
+            obj = {
+                "type": "FeatureCollection",
+                "features": [
+                    json.loads(line) for line in text.splitlines() if line.strip()
+                ],
+            }
     else:
         obj = path_or_obj
     feats = obj["features"] if obj.get("type") == "FeatureCollection" else [obj]
