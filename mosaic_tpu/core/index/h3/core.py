@@ -39,6 +39,8 @@ def _tables_for(xp):
 
 def geo_to_cell(lat, lng, res: int, xp=np):
     """(N,) lat/lng radians -> (N,) int64 H3 cell ids at ``res``."""
+    if xp is not np:
+        return _geo_to_cell_device(lat, lng, res, xp)
     t, fijk_bc, fijk_rot, is_pent, pent_cw = _tables_for(xp)
     face, x, y = hm.geo_to_hex2d(lat, lng, res, xp=xp)
     i, j, k = hm.hex2d_to_ijk(x, y, xp)
@@ -112,6 +114,98 @@ def geo_to_cell(lat, lng, res: int, xp=np):
         digits = xp.where((rot >= n)[..., None], rotated, digits)
 
     return hm.pack(bc, digits, res, xp)
+
+
+def _geo_to_cell_device(lat, lng, res: int, xp):
+    """jit-path geo_to_cell tuned for TPU: int32 digit math of width
+    ``res`` (no emulated-int64 inner loop, no (N, 15) padding), ONE
+    composed-table gather for the hexagon base-cell rotation, and the
+    whole pentagon correction behind a `lax.cond` so batches with no
+    pentagon points (any real-world region) skip it at runtime.
+
+    Bit-identical to the numpy path (device/host parity tests).
+    """
+    import jax
+    from jax import lax
+
+    t, fijk_bc, fijk_rot, is_pent, pent_cw = _tables_for(xp)
+    face, x, y = hm.geo_to_hex2d(lat, lng, res, xp=xp)
+    i, j, k = hm.hex2d_to_ijk(x, y, xp)
+    i = i.astype(xp.int32)
+    j = j.astype(xp.int32)
+    k = k.astype(xp.int32)
+
+    digit_list = [None] * res
+    for r in range(res, 0, -1):
+        li, lj, lk = i, j, k
+        if hm.is_class_iii(r):
+            i, j, k = hm.up_ap7(i, j, k, xp)
+            ci, cj, ck = hm.down_ap7(i, j, k, xp)
+        else:
+            i, j, k = hm.up_ap7r(i, j, k, xp)
+            ci, cj, ck = hm.down_ap7r(i, j, k, xp)
+        di, dj, dk = hm.ijk_normalize(li - ci, lj - cj, lk - ck, xp)
+        digit_list[r - 1] = hm.unit_ijk_to_digit_i32(di, dj, dk, xp)
+    digits = (
+        xp.stack(digit_list, axis=-1)
+        if res
+        else xp.zeros(lat.shape + (0,), xp.int32)
+    )  # (N, res) int32
+
+    i = xp.clip(i, 0, 2)
+    j = xp.clip(j, 0, 2)
+    k = xp.clip(k, 0, 2)
+    bc = fijk_bc[face, i, j, k]
+    rot = fijk_rot[face, i, j, k]
+    pent = is_pent[bc]
+
+    # hexagons: all `rot` ccw rotations composed into one (6, 8) gather
+    pow_tab = xp.asarray(hm.ROT60_CCW_POW, dtype=xp.int32)
+    rot_eff = xp.where(pent, 0, rot)
+    digits_hex = pow_tab[rot_eff[..., None], digits]
+
+    if res == 0:
+        return hm.pack_packed(bc, digits_hex, res, xp)
+
+    def _pent_fix(args):
+        digits, digits_hex = args
+        lead = _lead_digit(digits, xp)
+        cw_off = (pent_cw[bc, 0] == face) | (pent_cw[bc, 1] == face)
+        need = pent & (lead == C.K_AXES_DIGIT)
+        adj = xp.where(
+            cw_off[..., None],
+            _rot_tab(digits, C.ROT60_CW, xp),
+            _rot_tab(digits, C.ROT60_CCW, xp),
+        )
+        d = xp.where(need[..., None], adj, digits)
+        for n in range(1, 6):
+            rotated = _rotate_pent60_ccw_i32(d, xp)
+            d = xp.where(((rot >= n) & pent)[..., None], rotated, d)
+        return xp.where(pent[..., None], d, digits_hex)
+
+    digits = lax.cond(
+        xp.any(pent), _pent_fix, lambda a: a[1], (digits, digits_hex)
+    )
+    return hm.pack_packed(bc, digits, res, xp)
+
+
+def _rot_tab(digits, table, xp):
+    return xp.asarray(table, dtype=xp.int32)[digits]
+
+
+def _lead_digit(digits, xp):
+    """First non-zero digit along the last axis of (N, res) digits."""
+    nz = digits != 0
+    idx = xp.argmax(nz, axis=-1)
+    d = xp.take_along_axis(digits, idx[..., None], axis=-1)[..., 0]
+    return xp.where(nz.any(axis=-1), d, xp.zeros_like(d))
+
+
+def _rotate_pent60_ccw_i32(digits, xp):
+    rotated = _rot_tab(digits, C.ROT60_CCW, xp)
+    lead = _lead_digit(rotated, xp)
+    again = _rot_tab(rotated, C.ROT60_CCW, xp)
+    return xp.where((lead == C.K_AXES_DIGIT)[..., None], again, rotated)
 
 
 def cell_to_owned_fijk(cells, xp=np):

@@ -102,12 +102,22 @@ def hex2d_to_axial(x, y, xp=np):
     return rq.astype(np.int64), (-rr).astype(np.int64)
 
 
+def _round_div7(n, xp):
+    """Exact integer round-to-nearest(n / 7): floor((2n + 7) / 14).
+
+    Ties are impossible (7 is odd), and staying in integers keeps the
+    device path exact in int32 — a float32 quotient at res-15 magnitudes
+    carries ~0.08 absolute error, more than the 1/14 rounding margin.
+    """
+    return (2 * n + 7) // 14
+
+
 def up_ap7(i, j, k, xp=np):
     """Class III (ccw) aperture-7 parent."""
     ii = i - k
     jj = j - k
-    ni = xp.round((3 * ii - jj) / 7.0).astype(i.dtype)
-    nj = xp.round((ii + 2 * jj) / 7.0).astype(i.dtype)
+    ni = _round_div7(3 * ii - jj, xp).astype(i.dtype)
+    nj = _round_div7(ii + 2 * jj, xp).astype(i.dtype)
     return ijk_normalize(ni, nj, xp.zeros_like(ni), xp)
 
 
@@ -115,8 +125,8 @@ def up_ap7r(i, j, k, xp=np):
     """Class II (cw) aperture-7 parent."""
     ii = i - k
     jj = j - k
-    ni = xp.round((2 * ii + jj) / 7.0).astype(i.dtype)
-    nj = xp.round((3 * jj - ii) / 7.0).astype(i.dtype)
+    ni = _round_div7(2 * ii + jj, xp).astype(i.dtype)
+    nj = _round_div7(3 * jj - ii, xp).astype(i.dtype)
     return ijk_normalize(ni, nj, xp.zeros_like(ni), xp)
 
 
@@ -149,6 +159,19 @@ def unit_ijk_to_digit(i, j, k, xp=np):
     for d in range(7):
         hit = (i == uv[d, 0]) & (j == uv[d, 1]) & (k == uv[d, 2])
         digit = xp.where(hit, d, digit)
+    return digit
+
+
+def unit_ijk_to_digit_i32(i, j, k, xp=np):
+    """`unit_ijk_to_digit` in int32 — the device hot path avoids emulated
+    int64 arithmetic on TPU (int64 only appears in the final bit packing).
+    """
+    digit = xp.full(i.shape, C.INVALID_DIGIT, dtype=np.int32)
+    uv = np.asarray(C.UNIT_VECS, dtype=np.int32)
+    uv = uv if xp is np else xp.asarray(uv)
+    for d in range(7):
+        hit = (i == uv[d, 0]) & (j == uv[d, 1]) & (k == uv[d, 2])
+        digit = xp.where(hit, np.int32(d), digit)
     return digit
 
 
@@ -222,6 +245,34 @@ def pack(base_cell, digits, res: int, xp=np):
     for r in range(C.MAX_RES):
         shift = (C.MAX_RES - 1 - r) * C.PER_DIGIT_OFFSET
         h = h | (digits[..., r].astype(np.int64) << shift)
+    return h
+
+
+def pack_packed(base_cell, digits, res: int, xp=np):
+    """`pack` for width-``res`` digit arrays (N, res).
+
+    The unused finer levels are a compile-time INVALID(7) bit constant, and
+    the digits are first packed into int32 words (10 levels of 3 bits per
+    word) so the emulated-int64 work on TPU is at most two shift-ors per
+    point instead of ``res``."""
+    pad = 0
+    for r in range(res, C.MAX_RES):
+        pad |= C.INVALID_DIGIT << ((C.MAX_RES - 1 - r) * C.PER_DIGIT_OFFSET)
+    h = (
+        (np.int64(C.MODE_CELL) << C.MODE_OFFSET)
+        | np.int64(res << C.RES_OFFSET)
+        | np.int64(pad)
+        | (base_cell.astype(np.int64) << C.BASE_CELL_OFFSET)
+    )
+    # digit r sits at bit (MAX_RES-1-r)*3; group levels in int32 words
+    for g0 in range(0, res, 10):
+        g1 = min(g0 + 10, res)
+        acc = None
+        for r in range(g0, g1):
+            d = digits[..., r].astype(np.int32) << ((g1 - 1 - r) * 3)
+            acc = d if acc is None else acc | d
+        shift = (C.MAX_RES - g1) * C.PER_DIGIT_OFFSET
+        h = h | (acc.astype(np.int64) << shift)
     return h
 
 

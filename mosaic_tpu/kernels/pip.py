@@ -3,15 +3,22 @@
 This is the north-star kernel (BASELINE.json): the reference evaluates
 `ST_Contains` per row through JTS (`core/geometry/MosaicGeometryJTS.scala:101`)
 inside Spark codegen; here a block of points is tested against a whole
-polygon table resident in VMEM, with the edge dimension streamed through the
-grid so arbitrarily large polygon tables tile cleanly.
+polygon table resident in VMEM, with the edge and polygon dimensions
+streamed through the grid so arbitrarily large polygon tables tile cleanly.
 
-Layout: polygon edges are transposed to ``[E_pad, G_pad]`` coordinate planes
-(lane dimension = polygons, sublane = edges) so one edge across all polygons
-is a contiguous ``[1, G]`` vector row; points tile as ``[TN]`` blocks.
-The kernel accumulates per-(point, polygon) crossing parity and reduces to
-the smallest containing polygon id per point, so HBM output is O(N), not
-O(N·G).
+TPU layout (satisfies the (8, 128) f32 tile constraint):
+
+- points ride as ``[rows, 128]`` blocks — sublanes x lanes are both point
+  dims, so every vreg is full;
+- polygon edges are ``[4, E_pad, G_pad]`` coordinate planes whose blocks
+  are ``[4, tile_e, tile_g]`` (``tile_e`` sublane-, ``tile_g``
+  lane-aligned);
+- the per-(polygon, point) crossing accumulator is a 3-D
+  ``[tile_g, rows, 128]`` VMEM scratch — polygon index is the leading
+  (vreg-count) dim, so each edge step is pure element-wise vector math;
+- the grid is (point_blocks, g_blocks, e_blocks) with edges innermost;
+  the output block is revisited across g/e and min-accumulated, so HBM
+  output stays O(N).
 
 The jnp reference implementation (`core.geometry.predicates.contains_xy`)
 is the interpreted oracle; tests assert agreement (SURVEY.md §4(b)).
@@ -30,6 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ..core.geometry.device import DeviceGeometry
 
 _BIG_F = 1e30
+_SENT = 2**30  # python int: jnp scalars would be captured as kernel consts
 
 
 def _pad_to(x: np.ndarray | jax.Array, size: int, axis: int, value=0):
@@ -47,7 +55,7 @@ def edge_planes(polys: DeviceGeometry, g_pad: int = 128, e_pad: int = 64):
     Returns (planes, g_real) where planes[0..3] = ax, ay, bx, by and invalid
     edges are encoded as degenerate (ay == by == BIG) so they never straddle
     any point's scanline. ``e_pad`` should be a multiple of pip_zone's
-    ``tile_e`` (defaults are aligned).
+    ``tile_e`` and ``g_pad`` a multiple of its ``tile_g`` (defaults align).
     """
     from ..core.geometry.device import edges as _edges
 
@@ -75,26 +83,32 @@ def edge_planes(polys: DeviceGeometry, g_pad: int = 128, e_pad: int = 64):
     return planes, G
 
 
-def _pip_zone_kernel(px_ref, py_ref, planes_ref, out_ref, cnt, *, tile_e, n_real_g):
-    """Grid = (n_point_blocks, n_edge_blocks); edge dim innermost."""
-    e_blk = pl.program_id(1)
-    n_e = pl.num_programs(1)
+def _pip_zone_kernel(
+    px_ref, py_ref, planes_ref, out_ref, cnt, *, tile_e, tile_g, n_real_g
+):
+    """Grid = (point_blocks, g_blocks, e_blocks); edges innermost."""
+    g_blk = pl.program_id(1)
+    e_blk = pl.program_id(2)
+    n_e = pl.num_programs(2)
+
+    @pl.when(jnp.logical_and(g_blk == 0, e_blk == 0))
+    def _():
+        out_ref[:] = jnp.full_like(out_ref, jnp.int32(_SENT))
 
     @pl.when(e_blk == 0)
     def _():
         cnt[:] = jnp.zeros_like(cnt)
 
-    px = px_ref[0, :][:, None]  # (TN,1)
-    py = py_ref[0, :][:, None]
+    px = px_ref[:][None, :, :]  # (1, rows, 128)
+    py = py_ref[:][None, :, :]
 
-    def body(i, acc):
-        ay = planes_ref[1, i, :][None, :]  # (1,G)
-        by = planes_ref[3, i, :][None, :]
-        ax = planes_ref[0, i, :][None, :]
-        bx = planes_ref[2, i, :][None, :]
+    def body(t, acc):
+        ax = planes_ref[0, t, :][:, None, None]  # (tile_g, 1, 1)
+        ay = planes_ref[1, t, :][:, None, None]
+        bx = planes_ref[2, t, :][:, None, None]
+        by = planes_ref[3, t, :][:, None, None]
         straddle = (ay > py) != (by > py)
-        denom = by - ay
-        denom = jnp.where(denom == 0, 1.0, denom)
+        denom = jnp.where(by == ay, 1.0, by - ay)
         xcross = ax + (py - ay) * (bx - ax) / denom
         hit = straddle & (px < xcross)
         return acc + hit.astype(jnp.int32)
@@ -104,14 +118,19 @@ def _pip_zone_kernel(px_ref, py_ref, planes_ref, out_ref, cnt, *, tile_e, n_real
     @pl.when(e_blk == n_e - 1)
     def _():
         inside = (cnt[:] & 1) == 1
-        g_ids = jax.lax.broadcasted_iota(jnp.int32, cnt.shape, dimension=1)
-        valid = inside & (g_ids < n_real_g)
-        first = jnp.min(jnp.where(valid, g_ids, jnp.int32(2**30)), axis=1)
-        out_ref[0, :] = jnp.where(first == 2**30, -1, first)
+        gid = (
+            jax.lax.broadcasted_iota(jnp.int32, cnt.shape, 0)
+            + g_blk * tile_g
+        )
+        valid = inside & (gid < n_real_g)
+        best = jnp.min(
+            jnp.where(valid, gid, jnp.int32(_SENT)), axis=0
+        )  # (rows, 128)
+        out_ref[:] = jnp.minimum(out_ref[:], best)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_real_g", "tile_n", "tile_e", "interpret")
+    jax.jit, static_argnames=("n_real_g", "tile_n", "tile_e", "tile_g", "interpret")
 )
 def pip_zone(
     points: jax.Array,
@@ -119,51 +138,68 @@ def pip_zone(
     n_real_g: int | jax.Array = None,
     tile_n: int = 1024,
     tile_e: int = 64,
+    tile_g: int = 128,
     interpret: bool = False,
 ) -> jax.Array:
     """For each point, the id of the first polygon containing it, else -1.
 
     points: (N, 2); planes: (4, E, G) from :func:`edge_planes`.
-    N is padded to tile_n internally; E and G must already be padded
-    (edge_planes does this).
+    ``tile_n`` must be a multiple of 1024 (8 sublanes x 128 lanes of f32),
+    ``tile_g`` a multiple of 128; E and G are padded here if needed.
     """
     if n_real_g is None:
         n_real_g = planes.shape[2]
+    if tile_n % 1024:
+        raise ValueError(f"tile_n must be a multiple of 1024, got {tile_n}")
     N = points.shape[0]
     n_pad = ((N + tile_n - 1) // tile_n) * tile_n
-    px = _pad_to(points[:, 0], n_pad, 0, _BIG_F).reshape(-1, tile_n)
-    py = _pad_to(points[:, 1], n_pad, 0, _BIG_F).reshape(-1, tile_n)
+    rows = tile_n // 128
+    px = _pad_to(points[:, 0], n_pad, 0, _BIG_F).reshape(-1, 128)
+    py = _pad_to(points[:, 1], n_pad, 0, _BIG_F).reshape(-1, 128)
     E, G = planes.shape[1], planes.shape[2]
+    pad_vals = jnp.array([0.0, _BIG_F, 0.0, _BIG_F], planes.dtype)[:, None, None]
     if E % tile_e:
         e_sz = ((E + tile_e - 1) // tile_e) * tile_e
-        pad_vals = jnp.array([0.0, _BIG_F, 0.0, _BIG_F], planes.dtype)[:, None, None]
         planes = jnp.concatenate(
             [planes, jnp.broadcast_to(pad_vals, (4, e_sz - E, G))], axis=1
         )
         E = e_sz
-    n_blocks, n_e = px.shape[0], E // tile_e
+    if G % tile_g:
+        g_sz = ((G + tile_g - 1) // tile_g) * tile_g
+        planes = jnp.concatenate(
+            [planes, jnp.broadcast_to(pad_vals, (4, E, g_sz - G))], axis=2
+        )
+        G = g_sz
+    n_blocks, n_g, n_e = n_pad // tile_n, G // tile_g, E // tile_e
 
     kernel = functools.partial(
-        _pip_zone_kernel, tile_e=tile_e, n_real_g=int(n_real_g)
+        _pip_zone_kernel, tile_e=tile_e, tile_g=tile_g, n_real_g=int(n_real_g)
     )
     out = pl.pallas_call(
         kernel,
-        grid=(n_blocks, n_e),
+        grid=(n_blocks, n_g, n_e),
         in_specs=[
-            pl.BlockSpec((1, tile_n), lambda i, e: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tile_n), lambda i, e: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(
-                (4, tile_e, G), lambda i, e: (0, e, 0), memory_space=pltpu.VMEM
+                (rows, 128), lambda i, g, e: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (rows, 128), lambda i, g, e: (i, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (4, tile_e, tile_g),
+                lambda i, g, e: (0, e, g),
+                memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, tile_n), lambda i, e: (i, 0), memory_space=pltpu.VMEM
+            (rows, 128), lambda i, g, e: (i, 0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((n_blocks, tile_n), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((tile_n, G), jnp.int32)],
+        out_shape=jax.ShapeDtypeStruct((n_pad // 128, 128), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((tile_g, rows, 128), jnp.int32)],
         interpret=interpret,
     )(px, py, planes)
-    return out.reshape(-1)[:N]
+    out = out.reshape(-1)[:N]
+    return jnp.where(out >= _SENT, -1, out)
 
 
 def pip_zone_reference(points: jax.Array, polys: DeviceGeometry) -> jax.Array:

@@ -98,10 +98,15 @@ def pad_index_for_shards(index: ChipIndex, shards: int) -> ChipIndex:
         hash_mult=index.hash_mult,
         table_cell=index.table_cell,
         table_slot=index.table_slot,
-        cell_verts=pad0(index.cell_verts, du),
-        cell_elen=pad0(index.cell_elen, du),
-        cell_core=pad0(index.cell_core, du),
-        cell_geom=pad0(index.cell_geom, du, -1),
+        cell_edges=pad0(index.cell_edges, du),
+        cell_ebits=pad0(index.cell_ebits, du),
+        cell_slot_geom=pad0(index.cell_slot_geom, du, -1),
+        cell_slot_core=pad0(index.cell_slot_core, du),
+        cell_heavy=pad0(index.cell_heavy, du, -1),
+        # the heavy table is small and stays replicated — no padding needed
+        heavy_edges=index.heavy_edges,
+        heavy_ebits=index.heavy_ebits,
+        heavy_slot_geom=index.heavy_slot_geom,
     )
 
 
@@ -127,10 +132,14 @@ def _index_specs(spec, table_spec) -> ChipIndex:
         hash_mult=P(),
         table_cell=table_spec,
         table_slot=table_spec,
-        cell_verts=spec,
-        cell_elen=spec,
-        cell_core=spec,
-        cell_geom=spec,
+        cell_edges=spec,
+        cell_ebits=spec,
+        cell_slot_geom=spec,
+        cell_slot_core=spec,
+        cell_heavy=spec,
+        heavy_edges=P(),
+        heavy_ebits=P(),
+        heavy_slot_geom=P(),
     )
 
 
@@ -151,14 +160,21 @@ def _gather_index(idx: ChipIndex, axis_name: str, table_sharded: bool) -> ChipIn
         idx,
         table_cell=g(idx.table_cell) if table_sharded else idx.table_cell,
         table_slot=g(idx.table_slot) if table_sharded else idx.table_slot,
-        cell_verts=g(idx.cell_verts),
-        cell_elen=g(idx.cell_elen),
-        cell_core=g(idx.cell_core),
-        cell_geom=g(idx.cell_geom),
+        cell_edges=g(idx.cell_edges),
+        cell_ebits=g(idx.cell_ebits),
+        cell_slot_geom=g(idx.cell_slot_geom),
+        cell_slot_core=g(idx.cell_slot_core),
+        cell_heavy=g(idx.cell_heavy),
     )
 
 
-def distributed_join_step(mesh: Mesh, num_zones: int, table_size: int | None = None):
+def distributed_join_step(
+    mesh: Mesh,
+    num_zones: int,
+    table_size: int | None = None,
+    found_cap: int | None = None,
+    heavy_cap: int | None = None,
+):
     """Build the jitted full distributed join+aggregate step for ``mesh``.
 
     Returns ``step(points, pcells, index) -> (match, zone_counts)`` where
@@ -174,7 +190,9 @@ def distributed_join_step(mesh: Mesh, num_zones: int, table_size: int | None = N
       always correct (T is a power of two, so any power-of-two cell axis
       divides it; pass None to force replication);
     - ``match``   (N,) int32 matched polygon row (-1 none), sharded as input;
-    - ``zone_counts`` (num_zones,) int64, globally psum-reduced (replicated).
+    - ``zone_counts`` (num_zones,) int64, globally psum-reduced (replicated);
+    - ``found_cap``/``heavy_cap``  optional PER-SHARD compaction caps
+      forwarded to `pip_join_points` (defaults are exact — no overflow).
     """
     cell_shards = int(mesh.shape["cell"])
     table_sharded = (
@@ -187,7 +205,9 @@ def distributed_join_step(mesh: Mesh, num_zones: int, table_size: int | None = N
 
     def step(points, pcells, index):
         full = _gather_index(index, "cell", table_sharded=table_sharded)
-        match = pip_join_points(points, pcells, full)
+        match = pip_join_points(
+            points, pcells, full, heavy_cap=heavy_cap, found_cap=found_cap
+        )
         zone = jnp.where(match >= 0, match, num_zones).astype(jnp.int32)
         counts = jax.ops.segment_sum(
             jnp.ones_like(zone, dtype=jnp.int64), zone, num_segments=num_zones + 1

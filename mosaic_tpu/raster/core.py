@@ -70,7 +70,11 @@ class RasterBand:
         v = self.values
         if self.raster.nodata is None:
             return np.ones(v.shape, dtype=bool)
-        return v != np.asarray(self.raster.nodata, dtype=v.dtype)
+        nodata = np.asarray(self.raster.nodata, dtype=v.dtype)
+        if np.issubdtype(v.dtype, np.floating) and np.isnan(nodata):
+            # v != NaN is always True — NaN nodata needs an isnan mask
+            return ~np.isnan(v)
+        return v != nodata
 
     @property
     def masked_values(self) -> np.ndarray:

@@ -11,20 +11,25 @@ device-resident :class:`ChipIndex` which is small enough to replicate
 (all-gather over ICI) on every chip of a mesh, while the billion-point side
 shards over devices.
 
-The per-point probe is designed around TPU gather latency (random HBM row
-gathers are latency-bound at ~tens of ns each, independent of row size):
+The per-point probe is designed around TPU gather latency and HBM bandwidth:
 
-    key = (cell * A) >> (64 - log2 T)      multiply-shift hash, no search
-    bucket = table[key]                     1 gather: B candidate (cell, u)
-    u      = bucket row whose cell matches  parallel compare, no loop
-    chips  = cell_rows[u]                   1 WIDE gather: all M chips' edge
-                                            data, core flags and geom ids
-    hit    = core | ray_crossing(...)       fused vector math
+    key  = (cell * A) >> (64 - log2 T)    multiply-shift hash, no search
+    bkt  = table[key]                     1 gather: B candidate (cell, u)
+    u    = bucket row whose cell matches  parallel compare, no loop
+    edges= cell_edges[u]                  1 flat gather: the cell's chip
+                                          edges, capped at EDGE_CAP
+    par  = xor-reduce(crossing ? bit : 0) one parity bit per chip slot
+    hit  = core | parity bit              fused vector math
 
-Two parallel gathers per point, total — versus the 13 serially-dependent
-gathers of a binary search (searchsorted) plus ~3M small per-chip gathers,
-which measured ~10x slower on v5e. Everything is one fused XLA program: no
-host round-trip, no dynamic shapes.
+The edge table is FLAT per cell (not per-chip padded): every cell row holds
+at most ``EDGE_CAP`` edges, each tagged with the parity bit of the chip it
+belongs to. This kills the max-verts padding blow-up that a per-chip
+``(U, M, R, V, 2)`` layout suffers (one 309-vertex coastline chip would
+force every cell row to carry V=309 — ~10 GB of gather per 1M points, which
+made every >=1M batch fail TPU compilation in round 2). Cells whose chips
+carry more than ``EDGE_CAP`` edges (<8% of NYC cells) divert to a HEAVY side
+table: points landing in them are stream-compacted (cumsum + scatter, all
+static shapes) and only that compacted subset pays the wide heavy gather.
 """
 
 from __future__ import annotations
@@ -41,6 +46,19 @@ from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
 
 _SENTINEL = jnp.iinfo(jnp.int32).max
+_OVF_MARK = _SENTINEL - 1  # in-probe marker: tier-2 capacity exceeded
+
+#: per-cell flat edge capacity of the tier-1 probe; cells with more edges
+#: divert to the heavy table (measured on NYC res-9: cap 32 keeps 93% of
+#: cells in tier 1 and the heavy table holds <8k edges)
+EDGE_CAP = 32
+
+#: parity bits are uint32 — at most 32 chip slots per cell and per heavy row
+MAX_SLOTS = 32
+
+#: result code for points whose heavy-cell probe exceeded ``heavy_cap``
+#: (unknown result; raise the cap — `pip_join` sizes it exactly)
+OVERFLOW = -2
 
 
 @jax.tree_util.register_dataclass
@@ -64,10 +82,22 @@ class ChipIndex:
     table_cell: (T, B) int64 — bucketed hash table of cell ids (-1 empty);
                 T is a power of two, B the max bucket occupancy.
     table_slot: (T, B) int32 — cell slot u for each bucket entry (-1 empty).
-    cell_verts: (U, M, R, V, 2) — every cell's M chip polygons, gathered
-                into one row so the probe is a single wide gather.
-    cell_elen:  (U, M, R) int32 — ring lengths (edge masks) per chip.
-    cell_core:  (U, M) bool; cell_geom: (U, M) int32, -1 padded.
+
+    Tier-1 flat edge probe (light cells):
+
+    cell_edges:     (U, E1, 4) — ax, ay, bx, by per edge, zero pad (inert:
+                    a zero-length edge never straddles any scanline).
+    cell_ebits:     (U, E1) uint32 — parity bit ``1 << slot`` of the owning
+                    chip, 0 for pad edges.
+    cell_slot_geom: (U, M1) int32 — geom id per tier-1 chip slot, -1 pad.
+    cell_slot_core: (U, M1) bool — core chips hit without any edge test.
+    cell_heavy:     (U,) int32 — heavy-table row of this cell, -1 if light.
+
+    Tier-2 heavy table (cells whose border chips exceed EDGE_CAP edges):
+
+    heavy_edges:     (H, E2, 4); heavy_ebits: (H, E2) uint32.
+    heavy_slot_geom: (H, M2) int32 — geom per heavy chip slot, -1 pad.
+    H == 0 when no cell is heavy (tier 2 compiles away entirely).
     """
 
     cells: jax.Array
@@ -78,10 +108,14 @@ class ChipIndex:
     hash_mult: jax.Array
     table_cell: jax.Array
     table_slot: jax.Array
-    cell_verts: jax.Array
-    cell_elen: jax.Array
-    cell_core: jax.Array
-    cell_geom: jax.Array
+    cell_edges: jax.Array
+    cell_ebits: jax.Array
+    cell_slot_geom: jax.Array
+    cell_slot_core: jax.Array
+    cell_heavy: jax.Array
+    heavy_edges: jax.Array
+    heavy_ebits: jax.Array
+    heavy_slot_geom: jax.Array
 
     @property
     def num_cells(self) -> int:
@@ -91,25 +125,34 @@ class ChipIndex:
     def max_chips_per_cell(self) -> int:
         return int(self.chip_rows.shape[1])
 
+    @property
+    def num_heavy_cells(self) -> int:
+        return int(self.heavy_edges.shape[0])
+
 
 def _build_hash(cells: np.ndarray, max_bucket: int = 8):
     """Host: bucketed multiply-shift hash over the unique cell ids.
 
     Returns (mult, table_cell (T, B), table_slot (T, B)). T is sized ~4x the
-    cell count (power of two); the multiplier is retried until the fullest
-    bucket holds <= max_bucket entries, then B shrinks to the realized max.
+    cell count (power of two); the multiplier is retried (growing the table
+    each time) until the fullest bucket holds <= max_bucket entries, then B
+    shrinks to the realized max. The fallback keeps ``keys`` consistent with
+    the final ``bits`` even if every retry clusters: the last computed keys
+    are used as-is with a (possibly larger) realized B.
     """
     U = cells.shape[0]
     bits = max(4, int(np.ceil(np.log2(max(4 * U, 16)))))
+    bits_cap = bits + 6  # bound table growth (and host memory) at 64x
     rng = np.random.default_rng(0xC0FFEE)
-    for _ in range(32):
+    for attempt in range(32):
         mult = np.uint64(rng.integers(0, 2**64, dtype=np.uint64) | np.uint64(1))
         keys = (cells.astype(np.uint64) * mult) >> np.uint64(64 - bits)
         counts = np.bincount(keys.astype(np.int64), minlength=1 << bits)
         if counts.max() <= max_bucket:
             break
-        bits += 1  # grow the table if this multiplier clusters
-    B = int(counts.max())
+        if attempt < 31 and bits < bits_cap:
+            bits += 1  # grow the table if this multiplier clusters
+    B = int(counts.max()) if U else 1
     T = 1 << bits
     table_cell = np.full((T, B), -1, dtype=np.int64)
     table_slot = np.full((T, B), -1, dtype=np.int32)
@@ -121,11 +164,16 @@ def _build_hash(cells: np.ndarray, max_bucket: int = 8):
     return mult, table_cell, table_slot
 
 
+def _round8(n: int, lo: int = 8) -> int:
+    return max(lo, (n + 7) // 8 * 8)
+
+
 def build_chip_index(
     table: ChipTable,
     dtype=jnp.float32,
     max_chips_per_cell: int | None = None,
     recenter: bool = True,
+    edge_cap: int = EDGE_CAP,
 ) -> ChipIndex:
     """Host: compile a ChipTable into the device join index."""
     C = len(table)
@@ -141,9 +189,12 @@ def build_chip_index(
         raise ValueError(
             f"cell with {counts.max()} chips exceeds max_chips_per_cell={M}"
         )
-    rows = np.full((uniq.size, M), -1, dtype=np.int32)
+    U = uniq.size
+    rows = np.full((U, M), -1, dtype=np.int32)
+    chip_cell_slot = np.full(C, -1, dtype=np.int64)  # chip -> cell row u
     for i, (s, c) in enumerate(zip(starts, counts)):
         rows[i, :c] = order[s : s + c]
+        chip_cell_slot[order[s : s + c]] = i
     # only border rows need vertices: blank core chip geometries before
     # padding so V is set by the clipped border chips, not the cell polygons
     chips = table.chips
@@ -164,35 +215,115 @@ def build_chip_index(
     # pip_join before they are narrowed.
     border = pack_to_device(chips, dtype=dtype, recenter=recenter)
 
-    # probe fast path: hash table + per-cell packed chip rows
+    # probe fast path: hash table + flat per-cell edge rows
     mult, table_cell, table_slot = _build_hash(uniq)
-    bverts = np.asarray(border.verts)
-    blen = np.asarray(border.ring_len)
-    U = uniq.size
-    _, R, V, _ = bverts.shape
-    cell_verts = np.zeros((U, M, R, V, 2), dtype=bverts.dtype)
-    cell_elen = np.zeros((U, M, R), dtype=np.int32)
-    cell_core = np.zeros((U, M), dtype=bool)
-    cell_geom = np.full((U, M), -1, dtype=np.int32)
-    valid = rows >= 0
-    rs = np.maximum(rows, 0)
-    cell_verts[:] = bverts[rs]
-    cell_verts[~valid] = 0.0
-    cell_elen[:] = blen[rs]
-    cell_elen[~valid] = 0
-    # non-polygonal chips (line/point tessellations) must contribute no
-    # edges: their rings are open, so the closed-ring edge mask would admit
-    # a phantom edge to the zero pad and flip crossing parity (same guard
-    # as predicates._poly_edges). is_core still matches them exactly.
+
     from ..core.types import GeometryType
 
+    bverts = np.asarray(border.verts)  # (C, R, V, 2), recentered, dtype
+    blen = np.asarray(border.ring_len)  # (C, R)
     btype = np.asarray(border.geom_type)
-    poly = (btype[rs] == GeometryType.POLYGON) | (
-        btype[rs] == GeometryType.MULTIPOLYGON
+    is_poly = (btype == GeometryType.POLYGON) | (btype == GeometryType.MULTIPOLYGON)
+    contributes = is_poly & ~table.is_core  # chips whose edges are probed
+
+    # flat edge extraction: one (chip, ring, e) triple per real edge, in
+    # chip-major order (closed rings: vertex ring_len repeats vertex 0)
+    Rr, V = bverts.shape[1], bverts.shape[2]
+    e_idx = np.arange(V - 1)
+    emask = (
+        contributes[:, None, None]
+        & (e_idx[None, None, :] < blen[:, :, None])
+    )  # (C, R, V-1)
+    ec, er, ee = np.nonzero(emask)
+    e_a = bverts[ec, er, ee]  # (E, 2)
+    e_b = bverts[ec, er, ee + 1]
+    edges_all = np.concatenate([e_a, e_b], axis=1).astype(bverts.dtype)  # (E,4)
+    e_cell = chip_cell_slot[ec]  # (E,) cell row u per edge
+
+    # per-cell edge totals decide light vs heavy
+    epc = np.bincount(e_cell, minlength=U)
+    heavy_mask = epc > edge_cap
+    heavy_u = np.nonzero(heavy_mask)[0]
+    H = heavy_u.size
+    cell_heavy = np.full(U, -1, dtype=np.int32)
+    cell_heavy[heavy_u] = np.arange(H, dtype=np.int32)
+
+    # chip slot assignment per tier: tier-1 keeps every chip of light cells
+    # plus core/non-polygonal chips of heavy cells; heavy border chips get
+    # tier-2 slots. Slot numbers are per-cell-local (parity bit positions).
+    # Vectorized: per-tier rank within each cell via cumsum-of-flags minus
+    # the cumsum at the cell's start (chips in `order` are cell-grouped).
+    chip_heavy_tier = contributes & heavy_mask[chip_cell_slot]
+    f2 = chip_heavy_tier[order]
+    f1 = ~f2
+    c1 = np.cumsum(f1)
+    c2 = np.cumsum(f2)
+    start_pos = np.repeat(starts, counts)  # sorted-pos of each chip's cell start
+    base1 = np.concatenate([[0], c1])[start_pos]
+    base2 = np.concatenate([[0], c2])[start_pos]
+    rank1 = c1 - 1 - base1  # valid where f1
+    rank2 = c2 - 1 - base2  # valid where f2
+    t1_slot = np.full(C, -1, dtype=np.int64)
+    t2_slot = np.full(C, -1, dtype=np.int64)
+    t1_slot[order[f1]] = rank1[f1]
+    t2_slot[order[f2]] = rank2[f2]
+    n1_per_cell = np.bincount(chip_cell_slot[~chip_heavy_tier], minlength=U)
+    n2_per_cell = np.bincount(chip_cell_slot[chip_heavy_tier], minlength=U)
+    M1 = max(1, int(n1_per_cell.max(initial=0)))
+    M2 = max(1, int(n2_per_cell.max(initial=0)))
+    if M1 > MAX_SLOTS or M2 > MAX_SLOTS:
+        raise ValueError(
+            f"a cell holds more than {MAX_SLOTS} chips per probe tier "
+            f"(M1={M1}, M2={M2}); parity bits are uint32 — merge chips or "
+            "raise the tessellation resolution"
+        )
+    slot_geom = np.full((U, M1), -1, dtype=np.int32)
+    slot_core = np.zeros((U, M1), dtype=bool)
+    ch1 = np.nonzero(~chip_heavy_tier)[0]
+    slot_geom[chip_cell_slot[ch1], t1_slot[ch1]] = table.geom_id[ch1].astype(
+        np.int32
     )
-    cell_elen[~poly] = 0
-    cell_core[:] = table.is_core[rs] & valid
-    cell_geom[valid] = table.geom_id[rs[valid]].astype(np.int32)
+    slot_core[chip_cell_slot[ch1], t1_slot[ch1]] = table.is_core[ch1]
+
+    # pack tier-1 edges: light-tier edges only, grouped per cell
+    t1_edge = t1_slot[ec] >= 0
+    E1 = _round8(min(int(epc.max(initial=0)), edge_cap))
+    cell_edges = np.zeros((U, E1, 4), dtype=bverts.dtype)
+    cell_ebits = np.zeros((U, E1), dtype=np.uint32)
+    if t1_edge.any():
+        cu = e_cell[t1_edge]
+        ord1 = np.argsort(cu, kind="stable")
+        cu = cu[ord1]
+        ed = edges_all[t1_edge][ord1]
+        bits = np.uint32(1) << t1_slot[ec][t1_edge][ord1].astype(np.uint32)
+        pos = np.arange(cu.size) - np.searchsorted(cu, cu)
+        cell_edges[cu, pos] = ed
+        cell_ebits[cu, pos] = bits
+
+    # pack tier-2 heavy rows
+    if H:
+        t2_edge = t2_slot[ec] >= 0
+        hrow = cell_heavy[e_cell[t2_edge]].astype(np.int64)
+        ord2 = np.argsort(hrow, kind="stable")
+        hrow = hrow[ord2]
+        ed2 = edges_all[t2_edge][ord2]
+        bits2 = np.uint32(1) << t2_slot[ec][t2_edge][ord2].astype(np.uint32)
+        eph = np.bincount(hrow, minlength=H)
+        E2 = _round8(int(eph.max(initial=1)))
+        heavy_edges = np.zeros((H, E2, 4), dtype=bverts.dtype)
+        heavy_ebits = np.zeros((H, E2), dtype=np.uint32)
+        pos2 = np.arange(hrow.size) - np.searchsorted(hrow, hrow)
+        heavy_edges[hrow, pos2] = ed2
+        heavy_ebits[hrow, pos2] = bits2
+        hgeom = np.full((H, M2), -1, dtype=np.int32)
+        ch2 = np.nonzero(chip_heavy_tier)[0]
+        hgeom[
+            cell_heavy[chip_cell_slot[ch2]], t2_slot[ch2]
+        ] = table.geom_id[ch2].astype(np.int32)
+    else:
+        heavy_edges = np.zeros((0, 8, 4), dtype=bverts.dtype)
+        heavy_ebits = np.zeros((0, 8), dtype=np.uint32)
+        hgeom = np.zeros((0, 1), dtype=np.int32)
 
     return ChipIndex(
         cells=jnp.asarray(uniq, dtype=jnp.int64),
@@ -203,22 +334,96 @@ def build_chip_index(
         hash_mult=jnp.asarray(np.asarray([mult], dtype=np.uint64)),
         table_cell=jnp.asarray(table_cell),
         table_slot=jnp.asarray(table_slot),
-        cell_verts=jnp.asarray(cell_verts),
-        cell_elen=jnp.asarray(cell_elen),
-        cell_core=jnp.asarray(cell_core),
-        cell_geom=jnp.asarray(cell_geom),
+        cell_edges=jnp.asarray(cell_edges),
+        cell_ebits=jnp.asarray(cell_ebits),
+        cell_slot_geom=jnp.asarray(slot_geom),
+        cell_slot_core=jnp.asarray(slot_core),
+        cell_heavy=jnp.asarray(cell_heavy),
+        heavy_edges=jnp.asarray(heavy_edges),
+        heavy_ebits=jnp.asarray(heavy_ebits),
+        heavy_slot_geom=jnp.asarray(hgeom),
     )
 
 
+def _ray_parity(px, py, edges, bits):
+    """XOR-accumulated crossing parity bits.
+
+    px, py: (...,); edges: (..., E, 4) ax/ay/bx/by; bits: (..., E) uint32
+    (0 for pad edges — a zero edge has ay == by so it never straddles).
+    Returns (...,) uint32 where bit m is the ray-crossing parity of chip
+    slot m.
+    """
+    ax, ay = edges[..., 0], edges[..., 1]
+    bx, by = edges[..., 2], edges[..., 3]
+    pyb, pxb = py[..., None], px[..., None]
+    straddle = (ay > pyb) != (by > pyb)
+    denom = jnp.where(by == ay, jnp.ones_like(by), by - ay)
+    xcross = ax + (pyb - ay) * (bx - ax) / denom
+    crossed = straddle & (pxb < xcross)
+    vals = jnp.where(crossed, bits, jnp.zeros_like(bits))
+    return jax.lax.reduce(
+        vals, np.uint32(0), jax.lax.bitwise_xor, (vals.ndim - 1,)
+    )
+
+
+def _slot_best(parity, geoms, cores=None):
+    """Smallest geom id among hit slots (SENTINEL if none).
+
+    parity: (...,) uint32; geoms: (..., M) int32 (-1 pad);
+    cores: (..., M) bool or None.
+    """
+    Mn = geoms.shape[-1]
+    m = jnp.arange(Mn, dtype=jnp.uint32)
+    inside = ((parity[..., None] >> m) & jnp.uint32(1)).astype(bool)
+    hit = inside if cores is None else (cores | inside)
+    hit = hit & (geoms >= 0)
+    return jnp.min(jnp.where(hit, geoms, _SENTINEL), axis=-1)
+
+
+def _compact(flag: jax.Array, cap: int):
+    """Stream-compact: indices of up-to-``cap`` True rows (static shape).
+
+    Returns (src (cap,) int32, valid (cap,) bool, overflow (N,) bool):
+    ``src`` lists the first ``cap`` flagged row ids (padded arbitrarily,
+    masked by ``valid``); ``overflow`` marks flagged rows beyond ``cap``.
+    """
+    n = flag.shape[0]
+    pos = jnp.cumsum(flag.astype(jnp.int32)) - 1
+    dest = jnp.where(flag & (pos < cap), pos, cap)
+    src = (
+        jnp.zeros(cap + 1, dtype=jnp.int32)
+        .at[dest]
+        .set(jnp.arange(n, dtype=jnp.int32))[:cap]
+    )
+    count = jnp.sum(flag.astype(jnp.int32))
+    valid = jnp.arange(cap, dtype=jnp.int32) < count
+    return src, valid, flag & (pos >= cap)
+
+
 def pip_join_points(
-    points: jax.Array, pcells: jax.Array, index: ChipIndex
+    points: jax.Array,
+    pcells: jax.Array,
+    index: ChipIndex,
+    heavy_cap: int | None = None,
+    found_cap: int | None = None,
 ) -> jax.Array:
     """(N,) int32 — smallest matching polygon row per point, -1 if none.
 
-    Jittable; shard the point axis over a mesh and replicate ``index``.
-    Probe = hash lookup (1 gather) + packed cell row (1 wide gather) + fused
-    ray crossing over (N, M, R, E) — see module docstring for why.
+    Jittable (``heavy_cap``/``found_cap`` static); shard the point axis over
+    a mesh and replicate ``index``. Probe = hash lookup (1 gather), then
+    stream-compaction of the points whose cell exists in the index (misses
+    skip all edge work — on sparse workloads most points stop here), then a
+    flat bounded edge gather + XOR crossing parity; points in heavy cells
+    are compacted once more for the tier-2 gather.
+
+    ``found_cap`` bounds how many points per call may hit an indexed cell
+    and ``heavy_cap`` how many may land in heavy cells. Both default to
+    their exact upper bound (N / found_cap), so an uncapped call is always
+    exact — tighter caps are a performance knob. If a cap is exceeded the
+    excess points return :data:`OVERFLOW` (-2) instead of a wrong answer;
+    `pip_join` sizes both caps exactly from host-side counts.
     """
+    N = points.shape[0]
     T = index.table_cell.shape[0]
     shift_bits = jnp.uint64(64 - int(np.log2(T)))
     key = (
@@ -229,37 +434,58 @@ def pip_join_points(
     match = (cand_cell == pcells[:, None]) & (cand_slot >= 0)
     u = jnp.max(jnp.where(match, cand_slot, -1), axis=1)  # (N,)
     found = u >= 0
-    us = jnp.maximum(u, 0)
 
-    verts = index.cell_verts[us]  # (N, M, R, V, 2) — the one wide gather
-    elen = index.cell_elen[us]  # (N, M, R)
-    core = index.cell_core[us]  # (N, M)
-    geom = index.cell_geom[us]  # (N, M)
+    K1 = int(found_cap) if found_cap else N
+    K1 = max(8, min(K1, N))
+    src1, valid1, over1 = _compact(found, K1)
+    us = jnp.maximum(u[src1], 0)  # (K1,)
+    px, py = points[src1, 0], points[src1, 1]
 
-    a = verts[..., :-1, :]
-    b = verts[..., 1:, :]
-    px = points[:, 0][:, None, None, None]
-    py = points[:, 1][:, None, None, None]
-    ay, by = a[..., 1], b[..., 1]
-    straddle = (ay > py) != (by > py)
-    denom = by - ay
-    denom = jnp.where(denom == 0, 1.0, denom)
-    xcross = a[..., 0] + (py - ay) * (b[..., 0] - a[..., 0]) / denom
-    emask = (
-        jnp.arange(verts.shape[3] - 1, dtype=jnp.int32)[None, None, None, :]
-        < elen[..., None]
+    parity = _ray_parity(px, py, index.cell_edges[us], index.cell_ebits[us])
+    best1 = _slot_best(
+        parity, index.cell_slot_geom[us], index.cell_slot_core[us]
     )
-    crossings = jnp.sum(
-        (straddle & (px < xcross) & emask).astype(jnp.int32), axis=(-2, -1)
-    )  # (N, M)
-    inside = (crossings & 1) == 1
-    hit = found[:, None] & (geom >= 0) & (core | inside)
-    best = jnp.min(jnp.where(hit, geom, _SENTINEL), axis=1)
-    return jnp.where(best == _SENTINEL, -1, best).astype(jnp.int32)
+    best1 = jnp.where(valid1, best1, _SENTINEL)
+
+    H = int(index.heavy_edges.shape[0])
+    if H:
+        # tier 2: compact again to the points whose cell is heavy
+        K2 = int(heavy_cap) if heavy_cap else K1
+        K2 = min(K2, K1)
+        hs = jnp.where(valid1, index.cell_heavy[us], -1)
+        src2, valid2, over2 = _compact(hs >= 0, K2)
+        h2 = jnp.maximum(hs[src2], 0)
+        par2 = _ray_parity(
+            px[src2], py[src2], index.heavy_edges[h2], index.heavy_ebits[h2]
+        )
+        best2k = jnp.where(
+            valid2, _slot_best(par2, index.heavy_slot_geom[h2]), _SENTINEL
+        )
+        best2 = (
+            jnp.full(K1, _SENTINEL, dtype=jnp.int32).at[src2].min(best2k)
+        )
+        best1 = jnp.minimum(best1, best2)
+        # an overflowed tier-2 point has an unknown answer even if tier 1
+        # hit: mark it (marker < SENTINEL so the scatter-min keeps it)
+        best1 = jnp.where(over2, _OVF_MARK, best1)
+
+    # scatter compacted results back to the full point axis
+    best = (
+        jnp.full(N, _SENTINEL, dtype=jnp.int32)
+        .at[src1]
+        .min(jnp.where(valid1, best1, _SENTINEL))
+    )
+    out = jnp.where(best == _SENTINEL, -1, best).astype(jnp.int32)
+    out = jnp.where(best == _OVF_MARK, OVERFLOW, out)
+    return jnp.where(over1, OVERFLOW, out)
 
 
 # module-level jit so repeated pip_join calls share the compilation cache
-_JIT_JOIN = jax.jit(pip_join_points)
+_JIT_JOIN = jax.jit(pip_join_points, static_argnames=("heavy_cap", "found_cap"))
+
+
+def _next_pow2(n: int, lo: int = 16) -> int:
+    return max(lo, 1 << int(np.ceil(np.log2(max(n, 1)))))
 
 
 def pip_join(
@@ -276,7 +502,8 @@ def pip_join(
     Tessellates ``polygons`` (unless a prebuilt ``chip_index`` is passed),
     assigns cells to ``points`` and returns the matched polygon row per
     point (-1 = no polygon). ``batch_size`` chunks the point axis to bound
-    the (N·M·E) predicate intermediate.
+    the probe intermediates. The heavy-tier capacity is sized exactly from
+    the realized heavy-cell hit count, so no point can overflow.
     """
     resolution = index_system.resolution_arg(resolution)
     if chip_index is None:
@@ -286,13 +513,31 @@ def pip_join(
     # shift in f64 first, narrow after (keeps f32 ulp small near the data)
     shift = np.asarray(chip_index.border.shift, dtype=np.float64)
     dtype = chip_index.border.verts.dtype
-    step = _JIT_JOIN
     n = raw.shape[0]
+    index_cells = np.asarray(chip_index.cells)
+    heavy_cells = None
+    if chip_index.num_heavy_cells:
+        hmask = np.asarray(chip_index.cell_heavy) >= 0
+        heavy_cells = index_cells[hmask]
 
     def run(chunk: np.ndarray) -> np.ndarray:
         cells = index_system.point_to_cell(jnp.asarray(chunk), resolution)
+        # size both compaction caps exactly (pow2-bucketed to bound the
+        # number of distinct compiled programs) — overflow impossible
+        cnp = np.asarray(cells)
+        pos = np.clip(np.searchsorted(index_cells, cnp), 0, index_cells.size - 1)
+        fnp = index_cells[pos] == cnp
+        fcap = min(_next_pow2(int(fnp.sum()) + 1), chunk.shape[0])
+        hcap = None
+        if heavy_cells is not None:
+            n_heavy = int(np.isin(cnp[fnp], heavy_cells).sum())
+            hcap = min(_next_pow2(n_heavy + 1), fcap)
         shifted = jnp.asarray(chunk - shift, dtype=dtype)
-        return np.asarray(step(shifted, cells, chip_index))
+        return np.asarray(
+            _JIT_JOIN(
+                shifted, cells, chip_index, heavy_cap=hcap, found_cap=fcap
+            )
+        )
 
     if batch_size is None or n <= batch_size:
         return run(raw)

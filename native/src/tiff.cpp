@@ -88,6 +88,9 @@ static bool parseIFD(const Reader& r, size_t off, IFD& out, size_t* next) {
     e.count = r.u32(p + 4);
     size_t bytes = typeSize(e.type) * (size_t)e.count;
     e.value_off = bytes <= 4 ? p + 8 : (size_t)r.u32(p + 8);
+    // a truncated IFD (value bytes past EOF) must fail the parse rather
+    // than silently decode zeros through the bounds-checked Reader
+    if (e.value_off + bytes > r.n) return false;
     out.entries.push_back(e);
   }
   *next = r.u32(p);

@@ -92,7 +92,7 @@ def test_pallas_pip_matches_reference(polys, dev):
     pts = jnp.asarray(fx.random_points(777, bbox=(-1, -2, 11, 11), seed=4))
     planes, n_g = pip.edge_planes(dev)
     got = np.asarray(
-        pip.pip_zone(pts, planes, n_g, tile_n=256, tile_e=8, interpret=True)
+        pip.pip_zone(pts, planes, n_g, tile_n=1024, tile_e=8, interpret=True)
     )
     want = np.asarray(pip.pip_zone_reference(pts, dev))
     np.testing.assert_array_equal(got, want)
@@ -102,8 +102,37 @@ def test_pallas_pip_unaligned_n(dev):
     pts = jnp.asarray(fx.random_points(100, bbox=(-1, -2, 11, 11), seed=5))
     planes, n_g = pip.edge_planes(dev)
     got = np.asarray(
-        pip.pip_zone(pts, planes, n_g, tile_n=256, tile_e=8, interpret=True)
+        pip.pip_zone(pts, planes, n_g, tile_n=1024, tile_e=8, interpret=True)
     )
     assert got.shape == (100,)
+    want = np.asarray(pip.pip_zone_reference(pts, dev))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_pip_multiblock_g(dev):
+    """More polygons than one g-block: min-accumulation across g blocks.
+
+    tile_g=128 with G padded to 256 forces two g blocks in interpret mode.
+    """
+    pts = jnp.asarray(fx.random_points(512, bbox=(-1, -2, 11, 11), seed=6))
+    planes, n_g = pip.edge_planes(dev, g_pad=256)
+    got = np.asarray(
+        pip.pip_zone(
+            pts, planes, n_g, tile_n=1024, tile_e=8, tile_g=128, interpret=True
+        )
+    )
+    want = np.asarray(pip.pip_zone_reference(pts, dev))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu",
+    reason="compiled Pallas path needs a real TPU",
+)
+def test_pallas_pip_compiled_tpu(polys, dev):
+    """The kernel must COMPILE on TPU (not interpret) and agree."""
+    pts = jnp.asarray(fx.random_points(2048, bbox=(-1, -2, 11, 11), seed=7))
+    planes, n_g = pip.edge_planes(dev)
+    got = np.asarray(pip.pip_zone(pts, planes, n_g))
     want = np.asarray(pip.pip_zone_reference(pts, dev))
     np.testing.assert_array_equal(got, want)

@@ -1,0 +1,68 @@
+"""Regression tests for round-2 advisor findings.
+
+(a) `_build_hash` must stay self-consistent even when every multiplier
+    retry clusters (the fallback path);
+(b) float rasters with NaN nodata must mask NaN pixels (`v != NaN` is
+    always True);
+(c) a GeoTIFF whose IFD value bytes are truncated must fail the read with
+    an error code instead of silently decoding zeros.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.raster import Raster, read_raster, write_geotiff
+from mosaic_tpu.sql.join import _build_hash
+
+
+def test_build_hash_exhausted_retries_stay_consistent():
+    """max_bucket=0 forces every retry to 'fail': the returned (mult, T)
+    must still locate every cell (the round-2 bug desynced keys from T)."""
+    cells = np.sort(np.unique(np.random.default_rng(1).integers(
+        1, 2**60, 500, dtype=np.int64
+    )))
+    mult, table_cell, table_slot = _build_hash(cells, max_bucket=0)
+    T = table_cell.shape[0]
+    bits = int(np.log2(T))
+    keys = (cells.astype(np.uint64) * mult) >> np.uint64(64 - bits)
+    for u, (c, k) in enumerate(zip(cells, keys.astype(np.int64))):
+        row = table_cell[k]
+        hit = np.nonzero(row == c)[0]
+        assert hit.size == 1, f"cell {c} not findable under returned hash"
+        assert table_slot[k, hit[0]] == u
+
+
+def test_nan_nodata_masked():
+    data = np.full((1, 4, 5), 1.5, dtype=np.float32)
+    data[0, 0, 0] = np.nan
+    data[0, 1, 2] = np.nan
+    r = Raster(
+        data=data,
+        gt=(0.0, 1.0, 0.0, 0.0, 0.0, -1.0),
+        srid=4326,
+        nodata=float("nan"),
+    )
+    m = r.band(1).mask
+    assert not m[0, 0] and not m[1, 2]
+    assert m.sum() == 18
+    assert r.band(1).min() == 1.5  # NaN pixels excluded from stats
+
+
+def test_truncated_ifd_errors(tmp_path):
+    data = (np.arange(200, dtype=np.float64)).reshape(1, 10, 20)
+    r = Raster(
+        data=data.astype(np.float32),
+        gt=(0.0, 1.0, 0.0, 0.0, 0.0, -1.0),
+        srid=4326,
+        nodata=None,
+    )
+    p = tmp_path / "full.tif"
+    write_geotiff(str(p), r)
+    raw = p.read_bytes()
+    # truncate into the out-of-line IFD value area: offsets now point past
+    # EOF, which must be a hard read error, not a zero-filled success
+    for frac in (0.35, 0.6):
+        q = tmp_path / f"trunc_{frac}.tif"
+        q.write_bytes(raw[: int(len(raw) * frac)])
+        with pytest.raises(ValueError):
+            read_raster(str(q))
