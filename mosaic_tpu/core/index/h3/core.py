@@ -208,17 +208,23 @@ def _rotate_pent60_ccw_i32(digits, xp):
     return xp.where((lead == C.K_AXES_DIGIT)[..., None], again, rotated)
 
 
-def cell_to_owned_fijk(cells, xp=np):
-    """cells -> (face, i, j, k) integer lattice coords on the cell's OWNING
-    face (the face actually containing its center).
+def cell_center_frame(cells, xp=np):
+    """cells -> (face, x, y, res) CONTINUOUS hex2d coords of the cell
+    center on its owning face (the face actually containing its center).
 
     Descends from the base cell's home face, applying one aperture-7 step +
-    digit per level; whenever the running center drifts onto a neighboring
-    face, it is re-projected and re-rounded on that face *at the current
-    resolution*, so projection mismatch stays well under half a cell at
-    every level. This replaces the C library's table-driven
-    `_adjustOverageClassII` unfolding.
+    digit per level, then unfolds onto the owning face by planar triangle-
+    edge transforms (replacing the C library's table-driven
+    `_adjustOverageClassII`). On pentagon base cells the HOST (numpy) path
+    repairs the unfold to round-trip exactly (`_pentagon_unfold_repair`);
+    those centers are NOT lattice-aligned, which is why this returns
+    continuous coords. The traced jax path keeps the unrepaired lattice
+    approximation for pentagon children (eager jax arrays are routed
+    through the host path by `H3IndexSystem.cell_center`/`cell_boundary`).
     """
+    if xp is np and np.ndim(cells) == 0:
+        f, x, y, r = cell_center_frame(np.asarray(cells).reshape(1), xp)
+        return f[0], x[0], y[0], r[0]
     t, *_ = _tables_for(xp)
     res, bc, digits = hm.unpack(cells, xp)
     home_face = (t.home_face if xp is np else xp.asarray(t.home_face))[bc]
@@ -245,14 +251,40 @@ def cell_to_owned_fijk(cells, xp=np):
         j = xp.where(active, nj, j)
         k = xp.where(active, nk, k)
 
-    # unfold onto the owning face by exact planar lattice transforms across
-    # triangle edges (replaces the C library's _adjustOverageClassII tables)
+    x, y = hm.ijk_to_hex2d(i.astype(float), j.astype(float), k.astype(float), xp)
+    face, x, y = _unfold_to_owning_face(face, x, y, res, xp)
+
+    if xp is np and np.ndim(cells) and is_pent.any():
+        # pentagon base cells: the planar unfold does not model the deleted
+        # K sector, so some children land one 60-degree sector off. Repair
+        # by self-consistency: try +-60-degree rotations about the home
+        # triangle's corners/center before unfolding, and keep the first
+        # candidate whose center re-assigns (geo_to_cell) to the cell.
+        face, x, y = _pentagon_unfold_repair(
+            cells, bc, is_pent, home_face, digits, res, face, x, y
+        )
+
+    return face, x, y, res
+
+
+def cell_to_owned_fijk(cells, xp=np):
+    """cells -> (face, i, j, k, res) INTEGER lattice coords on the owning
+    face. Note pentagon-distorted children are not exactly lattice-aligned;
+    use :func:`cell_center_frame` for exact centers."""
+    face, x, y, res = cell_center_frame(cells, xp)
+    i, j, k = hm.hex2d_to_ijk(x, y, xp)
+    return face, i, j, k, res
+
+
+def _unfold_to_owning_face(face, x, y, res, xp=np):
+    """Unfold home-face hex2d coords onto the owning face by exact planar
+    lattice transforms across triangle edges (replaces the C library's
+    table-driven `_adjustOverageClassII` unfolding)."""
     t = derive()
     corners = _corners_by_res(xp)  # (16, 3, 2) canonical per-res triangle
     edge_nf = t.edge_neighbor_face if xp is np else xp.asarray(t.edge_neighbor_face)
     edge_cidx = t.edge_corner_idx if xp is np else xp.asarray(t.edge_corner_idx)
 
-    x, y = hm.ijk_to_hex2d(i.astype(float), j.astype(float), k.astype(float), xp)
     cr = corners[res]  # (N, 3, 2)
     for _hop in range(4):
         # signed side test per edge: cross(B-A, p-A); inside >= 0 (CCW tri)
@@ -270,7 +302,6 @@ def cell_to_owned_fijk(cells, xp=np):
         g = edge_nf[face, worst]
         ma = edge_cidx[face, worst, 0]
         mb = edge_cidx[face, worst, 1]
-        n_idx = xp.arange(face.shape[0]) if face.ndim else None
         Af = _take2(cr, worst, xp)
         Bf = _take2(cr, (worst + 1) % 3, xp)
         Ag = _take2(cr, ma, xp)
@@ -288,8 +319,201 @@ def cell_to_owned_fijk(cells, xp=np):
         x = xp.where(outside, nx2, x)
         y = xp.where(outside, ny2, y)
         face = xp.where(outside, g, face)
-    i, j, k = hm.hex2d_to_ijk(x, y, xp)
-    return face, i, j, k, res
+    return face, x, y
+
+
+def _pentagon_unfold_repair(cells, bc, is_pent, home_face, digits, res, face, x, y):
+    """Numpy-path repair of pentagon-child unfolds (see caller).
+
+    For every cell on a pentagon base cell, verify geo_to_cell(center) ==
+    cell; for failures, retry the unfold after rotating the descent point
+    +-60 degrees about each home-triangle corner and the centroid, keeping
+    the first self-consistent candidate. Exactness criterion = round-trip
+    consistency with geo_to_cell (the forward assignment is the ground
+    truth partition of the sphere in this framework).
+
+    ``is_pent`` is already per-cell (indexed by base cell in the caller).
+    """
+    xp = np
+    sel = np.nonzero(is_pent)[0]
+    if sel.size == 0:
+        return face, x, y
+    sub_cells = cells[sel]
+    sub_res = res[sel] if np.ndim(res) else np.full(sel.size, res)
+
+    def verified(la, lo, res_of, cell_of):
+        """Margin-verified assignment: the point AND four +-delta jitters
+        all map to the expected cell. Rotated lattice candidates can land
+        exactly on a hex-rounding tie, where any downstream ulp difference
+        (e.g. the degrees round-trip in the public API) flips the cell —
+        the jitter margin rejects such knife-edge centers."""
+        d = 3e-8
+        out = np.ones(la.shape[0], dtype=bool)
+        for dla, dlo in ((0, 0), (d, 0), (-d, 0), (0, d), (0, -d)):
+            for r in np.unique(res_of):
+                m = res_of == r
+                if not m.any():
+                    continue
+                got = geo_to_cell(la[m] + dla, lo[m] + dlo, int(r), xp)
+                out[m] &= got == cell_of[m]
+        return out
+
+    def center_ok(f, cx, cy):
+        la, lo = _per_res_geo(f, cx, cy, sub_res, xp)
+        return verified(la, lo, sub_res, sub_cells)
+
+    ok = center_ok(face[sel], x[sel], y[sel])
+    if ok.all():
+        return face, x, y
+    bad = sel[~ok]
+    bad_res = sub_res[~ok]
+    hf = home_face[bad]
+    corners = _corners_by_res(xp)
+    # recompute the pre-unfold descent point from the digits (subset only)
+    from . import hexmath as _hm
+
+    t = derive()
+    hijk = t.home_ijk[bc[bad]]
+    fi = hijk[:, 0].astype(np.int64)
+    fj = hijk[:, 1].astype(np.int64)
+    fk = hijk[:, 2].astype(np.int64)
+    max_r = int(bad_res.max(initial=0))
+    dsub = digits[bad]
+    for r in range(1, max_r + 1):
+        active = r <= bad_res
+        if _hm.is_class_iii(r):
+            ni, nj, nk = _hm.down_ap7(fi, fj, fk, xp)
+        else:
+            ni, nj, nk = _hm.down_ap7r(fi, fj, fk, xp)
+        d = np.where(active, dsub[..., r - 1], 0)
+        ni, nj, nk = _hm.ijk_add_digit(ni, nj, nk, d, xp)
+        fi = np.where(active, ni, fi)
+        fj = np.where(active, nj, fj)
+        fk = np.where(active, nk, fk)
+    x0, y0 = _hm.ijk_to_hex2d(fi.astype(float), fj.astype(float), fk.astype(float), xp)
+
+    fixed = np.zeros(bad.size, dtype=bool)
+    bx, by, bf = x[bad].copy(), y[bad].copy(), face[bad].copy()
+    cr = corners[bad_res]  # (B, 3, 2)
+    centroid = cr.mean(axis=1)  # (B, 2)
+    pivots = [cr[:, 0], cr[:, 1], cr[:, 2], centroid]
+    angles = [np.pi / 3, -np.pi / 3, 2 * np.pi / 3, -2 * np.pi / 3]
+    for pivot in pivots:
+        for ang in angles:
+            if fixed.all():
+                break
+            ca, sa = np.cos(ang), np.sin(ang)
+            rx = x0 - pivot[:, 0]
+            ry = y0 - pivot[:, 1]
+            nx2 = ca * rx - sa * ry + pivot[:, 0]
+            ny2 = sa * rx + ca * ry + pivot[:, 1]
+            ff, xx, yy = _unfold_to_owning_face(hf.copy(), nx2, ny2, bad_res, xp)
+            la, lo = _per_res_geo(ff, xx, yy, bad_res, xp)
+            good = verified(la, lo, bad_res, cells[bad])
+            take = good & ~fixed
+            bx[take], by[take], bf[take] = xx[take], yy[take], ff[take]
+            fixed |= good
+
+    if not fixed.all():
+        # last resort (a handful of coarse cells): estimate the center by
+        # sampling around the parent cell's center and taking the spherical
+        # centroid of the samples the forward assignment maps to this cell,
+        # then refine once. Deterministic (fixed lattice), verified by
+        # round-trip before acceptance.
+        rem = np.nonzero(~fixed)[0]
+        for q in rem:
+            cell = cells[bad][q]
+            r = int(bad_res[q])
+            parent = _parent_cell(cell, r)
+            pla, plo = cell_to_geo(np.asarray([parent]), np)
+            rad = _circumradius_rad(max(r - 1, 0)) * 1.6
+            est = None
+            n_samp = 600
+            for _round in range(6):
+                sla, slo = _disk_lattice(float(pla[0]), float(plo[0]), rad, n_samp)
+                hit = geo_to_cell(sla, slo, r, np) == cell
+                if not hit.any():
+                    # deleted-sector children can sit several parent radii
+                    # away: widen (and densify) until the region is found
+                    rad *= 1.8
+                    n_samp = min(n_samp * 2, 6000)
+                    continue
+                v = np.stack(
+                    [
+                        np.cos(sla[hit]) * np.cos(slo[hit]),
+                        np.cos(sla[hit]) * np.sin(slo[hit]),
+                        np.sin(sla[hit]),
+                    ],
+                    -1,
+                ).mean(0)
+                v /= np.linalg.norm(v)
+                est = (np.arcsin(v[2]), np.arctan2(v[1], v[0]))
+                if (
+                    geo_to_cell(np.asarray([est[0]]), np.asarray([est[1]]), r, np)[0]
+                    != cell
+                ):
+                    # nonconvex region: the centroid fell outside — use the
+                    # DEEPEST in-region sample (max distance to any non-hit
+                    # sample), which stays robustly interior
+                    if (~hit).any():
+                        d2 = (
+                            (sla[hit][:, None] - sla[~hit][None, :]) ** 2
+                            + (slo[hit][:, None] - slo[~hit][None, :]) ** 2
+                        ).min(axis=1)
+                        kbest = int(np.argmax(d2))
+                    else:
+                        kbest = 0
+                    est = (float(sla[hit][kbest]), float(slo[hit][kbest]))
+                pla = np.asarray([est[0]])
+                plo = np.asarray([est[1]])
+                rad = _circumradius_rad(r) * 1.2
+            if est is None:
+                continue
+            # express the center in the owning face's frame, then verify
+            # the FINAL representation (re-projected through the same path
+            # cell_to_geo will use) — an estimate near a cell boundary can
+            # flip under the projection round-trip's ulp differences
+            f1, _ = hm.nearest_face(np.asarray([est[0]]), np.asarray([est[1]]), np)
+            _, xx, yy = hm.geo_to_hex2d(
+                np.asarray([est[0]]), np.asarray([est[1]]), r, face=f1, xp=np
+            )
+            la2, lo2 = _per_res_geo(f1, xx, yy, np.asarray([r]), np)
+            if not verified(la2, lo2, np.asarray([r]), np.asarray([cell]))[0]:
+                continue
+            bf[q], bx[q], by[q] = f1[0], xx[0], yy[0]
+            fixed[q] = True
+
+    x = x.copy()
+    y = y.copy()
+    face = face.copy()
+    x[bad], y[bad], face[bad] = bx, by, bf
+    return face, x, y
+
+
+def _parent_cell(cell: int, res: int) -> int:
+    """Parent id at res-1: bump the res field, pad the finest digit."""
+    if res <= 0:
+        return int(cell)
+    h = int(cell)
+    h &= ~(0xF << C.RES_OFFSET)
+    h |= (res - 1) << C.RES_OFFSET
+    h |= C.INVALID_DIGIT << ((C.MAX_RES - res) * C.PER_DIGIT_OFFSET)
+    return h
+
+
+def _circumradius_rad(res: int) -> float:
+    return float(np.arctan(C.RES0_U_GNOMONIC / np.sqrt(3.0) / (C.SQRT7**res)))
+
+
+def _disk_lattice(lat0: float, lng0: float, rad: float, n: int):
+    """Deterministic Fibonacci lattice in a spherical cap around a point."""
+    gold = (1 + 5**0.5) / 2
+    ks = np.arange(n)
+    rr = rad * np.sqrt((ks + 0.5) / n)
+    th = 2 * np.pi * ks / gold
+    lat = lat0 + rr * np.cos(th)
+    lng = lng0 + rr * np.sin(th) / max(np.cos(lat0), 0.05)
+    return np.clip(lat, -np.pi / 2, np.pi / 2), lng
 
 
 def _take2(cr, idx, xp):
@@ -323,10 +547,12 @@ def _corners_by_res(xp):
 
 
 def cell_to_geo(cells, xp=np):
-    """(N,) int64 -> (lat, lng) radians of cell centers."""
-    face, i, j, k, res_arr = cell_to_owned_fijk(cells, xp)
-    x, y = hm.ijk_to_hex2d(i.astype(float), j.astype(float), k.astype(float), xp)
-    return _per_res_geo(face, x, y, res_arr, xp)
+    """(N,) int64 -> (lat, lng) radians of cell centers, lng in (-pi, pi]."""
+    face, x, y, res_arr = cell_center_frame(cells, xp)
+    lat, lng = _per_res_geo(face, x, y, res_arr, xp)
+    lng = xp.where(lng > np.pi, lng - 2 * np.pi, lng)
+    lng = xp.where(lng <= -np.pi, lng + 2 * np.pi, lng)
+    return lat, lng
 
 
 def _per_res_geo(face, x, y, res_arr, xp):
@@ -359,14 +585,12 @@ def _per_res_hex2d(lat, lng, res_arr, face, xp):
 def cell_boundary(cells, xp=np):
     """(N,) -> (N, 6, 2) lat/lng radians of cell vertices (CCW).
 
-    Round-1 approximation: 6 vertices at hex circumradius in the owning
-    face's grid frame; H3's extra distortion vertices on icosahedron edge
-    crossings are not yet emitted, and pentagons repeat one vertex.
+    6 vertices at hex circumradius in the owning face's grid frame; H3's
+    extra distortion vertices on icosahedron edge crossings are not
+    emitted. Pentagon cells are overridden with their 5 true vertices at
+    the `H3IndexSystem.cell_boundary` level (host path).
     """
-    oface, si, sj, sk, res_arr = cell_to_owned_fijk(cells, xp)
-    cx, cy = hm.ijk_to_hex2d(
-        si.astype(float), sj.astype(float), sk.astype(float), xp
-    )
+    oface, cx, cy, res_arr = cell_center_frame(cells, xp)
     rad = 1.0 / np.sqrt(3.0)
     lats = []
     lngs = []

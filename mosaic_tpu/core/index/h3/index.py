@@ -8,11 +8,14 @@ H3 C core over JNI per row). Here `point_to_cell` is one fused array program
 Coordinates are (lng, lat) degrees in xy order, matching GeoJSON and the
 rest of the framework.
 
-Known round-1 limitations (documented; affect only the 12 pentagon base
-cells — remote ocean/polar areas): pentagon digit adjustment is imperfect
-(~15% of pentagon-area points fail the cell->center->cell round trip),
-pentagon boundaries are emitted with 6 vertices, and neighbor stepping near
-pentagon distortion may skip a cell.
+Pentagon handling (round 3, HOST path — numpy and eager jax arrays): cell
+centers on the 12 pentagon base cells are round-trip exact
+(`core._pentagon_unfold_repair` — verified for all 12 base cells at res
+0-9 in tests), pentagon boundaries emit the 5 true vertices
+(`_pentagon_boundary`), and pentagon neighbor stepping yields the 5
+adjacent cells. Values traced under `jit` keep the unrepaired lattice
+approximation for pentagon children (hexagon base cells are exact on both
+paths).
 """
 
 from __future__ import annotations
@@ -64,19 +67,85 @@ class H3IndexSystem(IndexSystem):
         return core.geo_to_cell(lat, lng, resolution, xp)
 
     def cell_center(self, cells) -> jax.Array:
+        # eager jax arrays route through the host path so pentagon centers
+        # get the round-trip-exact repair; only traced values stay on the
+        # (pentagon-approximate) device path
+        if isinstance(cells, jax.Array) and not isinstance(cells, jax.core.Tracer):
+            return jnp.asarray(self.cell_center(np.asarray(cells)))
         xp = jnp if isinstance(cells, jax.Array) else np
         cells = xp.asarray(cells)
         lat, lng = core.cell_to_geo(cells, xp)
         return xp.stack([xp.degrees(lng), xp.degrees(lat)], axis=-1)
 
     def cell_boundary(self, cells) -> jax.Array:
+        if isinstance(cells, jax.Array) and not isinstance(cells, jax.core.Tracer):
+            return jnp.asarray(self.cell_boundary(np.asarray(cells)))
         xp = jnp if isinstance(cells, jax.Array) else np
         cells = xp.asarray(cells)
         lats, lngs = core.cell_boundary(cells, xp)
         # close the ring: repeat first vertex
         lats = xp.concatenate([lats, lats[..., :1]], axis=-1)
         lngs = xp.concatenate([lngs, lngs[..., :1]], axis=-1)
-        return xp.stack([xp.degrees(lngs), xp.degrees(lats)], axis=-1)
+        out = xp.stack([xp.degrees(lngs), xp.degrees(lats)], axis=-1)
+        if xp is np and out.ndim == 3:
+            pent = np.asarray(core.is_pentagon_cell(cells, np), dtype=bool)
+            if pent.any():
+                out = out.copy()
+                out[pent] = self._pentagon_boundary(
+                    np.asarray(cells)[pent].reshape(-1)
+                )
+        return out
+
+    def _pentagon_boundary(self, cells: np.ndarray) -> np.ndarray:
+        """(P,) pentagon cells -> (P, 7, 2) lng/lat deg: 5 TRUE vertices
+        (each the spherical circumcenter of the cell center and two
+        azimuth-adjacent neighbor centers — the point where three cells
+        meet), closed and padded by repeating the first vertex.
+
+        Reference behavior: the H3 C core emits 5 distinct vertices for
+        pentagons (`core/index/H3IndexSystem.scala:93-100` closes the ring
+        the same way)."""
+        P = cells.shape[0]
+        nb = self.neighbors(cells)  # (P, 6), -1 pads (pentagons have 5)
+        ctr = self.cell_center(cells)  # (P, 2) lng/lat deg
+        out = np.zeros((P, 7, 2))
+        for p in range(P):
+            ns = nb[p][nb[p] >= 0]
+            nctr = self.cell_center(ns)  # (K, 2)
+            clng, clat = np.radians(ctr[p, 0]), np.radians(ctr[p, 1])
+            nlng, nlat = np.radians(nctr[:, 0]), np.radians(nctr[:, 1])
+            az = np.arctan2(
+                np.sin(nlng - clng) * np.cos(nlat),
+                np.cos(clat) * np.sin(nlat)
+                - np.sin(clat) * np.cos(nlat) * np.cos(nlng - clng),
+            )
+            # ascending compass bearing sweeps CW; reverse for CCW rings
+            # (hexagon boundaries from the lattice path are CCW)
+            order = np.argsort(az)[::-1]
+            nlat, nlng = nlat[order], nlng[order]
+            c3 = np.array(
+                [np.cos(clat) * np.cos(clng), np.cos(clat) * np.sin(clng), np.sin(clat)]
+            )
+            n3 = np.stack(
+                [np.cos(nlat) * np.cos(nlng), np.cos(nlat) * np.sin(nlng), np.sin(nlat)],
+                -1,
+            )  # (K, 3)
+            K = n3.shape[0]
+            verts = []
+            for m in range(K):
+                a, b = n3[m], n3[(m + 1) % K]
+                v = np.cross(b - c3, a - c3)
+                v /= max(np.linalg.norm(v), 1e-15)
+                if np.dot(v, c3) < 0:
+                    v = -v
+                verts.append((np.arctan2(v[1], v[0]), np.arcsin(v[2])))
+            ring = np.asarray(verts)  # (K, 2) lng/lat rad
+            row = np.degrees(
+                np.concatenate([ring, ring[:1], ring[:1]], axis=0)
+            )[:7]
+            out[p, : row.shape[0]] = row
+            out[p, row.shape[0] :] = row[-1]
+        return out
 
     def is_valid(self, cells) -> jax.Array:
         xp = jnp if isinstance(cells, jax.Array) else np
@@ -97,10 +166,7 @@ class H3IndexSystem(IndexSystem):
         """
         xp = np
         cells = np.asarray(cells, dtype=np.int64).reshape(-1)
-        face, i, j, k, res_arr = core.cell_to_owned_fijk(cells, xp)
-        cx, cy = hm.ijk_to_hex2d(
-            i.astype(float), j.astype(float), k.astype(float), xp
-        )
+        face, cx, cy, res_arr = core.cell_center_frame(cells, xp)
         N = len(cells)
         # all 6 directions in one flattened projection/round-trip
         ang = np.arange(6) * (np.pi / 3)
@@ -113,7 +179,152 @@ class H3IndexSystem(IndexSystem):
         for r in np.unique(res6):
             sel = res6 == r
             ncell[sel] = core.geo_to_cell(lat[sel], lng[sel], int(r), xp)
-        return ncell.reshape(N, 6)
+        out = ncell.reshape(N, 6)
+
+        # pentagon-distorted rows: 6 lattice steps from a (repaired,
+        # non-lattice-aligned) center can miss an adjacent cell — re-derive
+        # those rows from a dense unit circle around the center. Applies to
+        # pentagons, rows that stepped onto themselves (distortion), and
+        # hexagons adjacent to a pentagon (their ring is distorted too).
+        pent = np.asarray(core.is_pentagon_cell(cells, xp), dtype=bool)
+        srt = np.sort(out, axis=1)
+        has_dup = (srt[:, 1:] == srt[:, :-1]).any(1)
+        nb_pent = np.asarray(
+            core.is_pentagon_cell(out.reshape(-1), xp), dtype=bool
+        ).reshape(N, 6).any(1)
+        # pentagon rows at res >= 1 are EXACT by construction (the center
+        # child's neighbors are its parent's 5 other children, K deleted)
+        sib_flag = np.zeros(N, dtype=bool)
+        for r in np.unique(res_arr[pent | nb_pent]) if (pent | nb_pent).any() else []:
+            rows = dict(self._pentagon_rows(int(r)))
+            m = res_arr == r
+            if int(r) >= 1:
+                for p in np.nonzero(m & pent)[0]:
+                    sibs = rows.get(int(cells[p]))
+                    if sibs is not None:
+                        row = np.full(6, -1, dtype=np.int64)
+                        s = sorted(sibs)[:6]
+                        row[: len(s)] = s
+                        out[p] = row
+                # hexagons that are pentagon siblings must list the pentagon
+                all_sibs = set()
+                for pc, ss in rows.items():
+                    all_sibs |= ss
+                sib_flag |= m & np.isin(cells, np.asarray(sorted(all_sibs)))
+        near_pent = (
+            (pent & (res_arr == 0))
+            | sib_flag
+            | nb_pent
+            | has_dup
+            | (out == cells[:, None]).any(1)
+        ) & ~(pent & (res_arr >= 1))  # sibling rows are exact: keep them
+        flagged = np.nonzero(near_pent)[0]
+        counts = {}
+        for p in flagged:
+            out[p], counts[p] = self._boundary_walk_neighbors(
+                int(cells[p]), int(face[p]), cx[p], cy[p], int(res_arr[p])
+            )
+        # symmetry patch: a pentagon-sibling hexagon whose boundary only
+        # grazes the pentagon in a wedge the ray walk straddled still must
+        # list it
+        for p in flagged:
+            if pent[p]:
+                continue
+            r = int(res_arr[p])
+            for pcell, prow in self._pentagon_rows(r):
+                if int(cells[p]) in prow and pcell not in out[p]:
+                    row = out[p]
+                    free = np.nonzero(row < 0)[0]
+                    if free.size:
+                        row[free[0]] = pcell
+                    else:
+                        # drop the least ray-supported entry
+                        cnt = counts.get(p, {})
+                        weakest = min(
+                            range(6), key=lambda m2: cnt.get(int(row[m2]), 0)
+                        )
+                        row[weakest] = pcell
+        return out
+
+    def _pentagon_rows(self, res: int):
+        """[(pentagon cell id, set of its 5 neighbors)] at ``res`` (cached).
+
+        res >= 1: exact by construction — the pentagon is the center child
+        of a pentagon parent, so its neighbors are the parent's children at
+        digits {2..6} (digit 1, the K axis, is deleted on pentagons).
+        res 0: derived by the boundary walk over base cells."""
+        cache = getattr(self, "_pent_row_cache", {})
+        if res not in cache:
+            t = derive()
+            rows = []
+            for bc in np.nonzero(t.is_pentagon)[0]:
+                digits = np.full((1, C.MAX_RES), C.INVALID_DIGIT, np.int64)
+                digits[:, :res] = 0
+                pcell = int(hm.pack(np.asarray([bc]), digits, res, np)[0])
+                if res >= 1:
+                    sibs = set()
+                    for d in (2, 3, 4, 5, 6):
+                        dd = digits.copy()
+                        dd[:, res - 1] = d
+                        sibs.add(int(hm.pack(np.asarray([bc]), dd, res, np)[0]))
+                    rows.append((pcell, sibs))
+                else:
+                    f, px, py, rr = core.cell_center_frame(
+                        np.asarray([pcell], dtype=np.int64), np
+                    )
+                    row, _ = self._boundary_walk_neighbors(
+                        pcell, int(f[0]), px[0], py[0], res
+                    )
+                    rows.append((pcell, set(int(v) for v in row if v >= 0)))
+            cache[res] = rows
+            self._pent_row_cache = cache
+        return cache[res]
+
+    @staticmethod
+    def _boundary_walk_neighbors(cell, face, cx, cy, res, n_rays: int = 36):
+        """Edge-sharing neighbors of one (distorted) cell by walking its
+        region boundary: in each direction, bisect the largest t with
+        geo_to_cell(center + t*dir) == cell, then step just beyond — the
+        cell found there shares boundary with ours. Exact for the
+        pentagon-distorted regions where fixed lattice steps mis-hit.
+        Returns (row (6,) int64 -1-padded, {cell: ray count})."""
+        ang = np.arange(n_rays) * (2 * np.pi / n_rays)
+        dx, dy = np.cos(ang), np.sin(ang)
+
+        def assign(t):
+            la, lo = core._per_res_geo(
+                np.full(n_rays, face), cx + t * dx, cy + t * dy,
+                np.full(n_rays, res), np,
+            )
+            return core.geo_to_cell(la, lo, res, np)
+
+        lo_t = np.zeros(n_rays)
+        hi_t = np.full(n_rays, 2.5)
+        # ensure hi is outside (region radius is ~<1.2 grid units)
+        for _ in range(3):
+            on_cell = assign(hi_t) == cell
+            if not on_cell.any():
+                break
+            hi_t = np.where(on_cell, hi_t * 2, hi_t)
+        for _ in range(20):
+            mid = (lo_t + hi_t) / 2
+            inside = assign(mid) == cell
+            lo_t = np.where(inside, mid, lo_t)
+            hi_t = np.where(inside, hi_t, mid)
+        nb = assign(lo_t + (hi_t - lo_t) * 2 + 1e-6)
+        uniq = [c for c in dict.fromkeys(nb.tolist()) if c != cell]
+        expected = 5 if bool(core.is_pentagon_cell(np.asarray([cell]), np)[0]) else 6
+        if len(uniq) < expected and n_rays < 288:
+            return H3IndexSystem._boundary_walk_neighbors(
+                cell, face, cx, cy, res, n_rays * 4
+            )
+        cnt = {}
+        for c in nb.tolist():
+            if c != cell:
+                cnt[c] = cnt.get(c, 0) + 1
+        row = np.full(6, -1, dtype=np.int64)
+        row[: min(6, len(uniq))] = uniq[:6]
+        return row, cnt
 
     def neighbors(self, cells) -> np.ndarray:
         """(N,) -> (N, 6) adjacent cells (edge-sharing), -1 pads for
@@ -188,18 +399,25 @@ class H3IndexSystem(IndexSystem):
     def grid_distance(self, cells_a, cells_b) -> np.ndarray:
         """Hex grid distance via planar ijk on a common face projection.
 
-        Exact when both cells project onto one face; across faces/pentagons
-        the unfolded estimate can deviate (documented limitation; the
-        reference's h3Distance has the same failure mode and returns -1)."""
+        Exact when both cells project onto one face. When the pair spans
+        icosahedron faces (either cell's owning face differs from the
+        common projection face) the planar unfold is unreliable, so -1 is
+        returned — the same flagged-failure contract as the reference's
+        `h3Distance` (`core/index/H3IndexSystem.scala`)."""
         xp = np
         a = np.asarray(cells_a, dtype=np.int64)
         b = np.asarray(cells_b, dtype=np.int64)
-        lat_a, lng_a = core.cell_to_geo(a, xp)
-        lat_b, lng_b = core.cell_to_geo(b, xp)
+        fa, xa_, ya_, res_a = core.cell_center_frame(a, xp)
+        fb, xb_, yb_, res_b = core.cell_center_frame(b, xp)
+        lat_a, lng_a = core._per_res_geo(fa, xa_, ya_, res_a, xp)
+        lat_b, lng_b = core._per_res_geo(fb, xb_, yb_, res_b, xp)
         res_arr = core.resolution(a, xp)
         face, _ = hm.nearest_face(
             (lat_a + lat_b) / 2, (lng_a + lng_b) / 2, xp
-        )  # midpoint face
+        )  # midpoint face (arithmetic midpoint is wrong at the
+        # antimeridian — when both cells share an owning face, that face
+        # is always the right projection surface)
+        face = np.where(fa == fb, fa, face)
         out = np.zeros(len(a), dtype=np.int64)
         for r in np.unique(res_arr):
             sel = res_arr == r
@@ -214,7 +432,8 @@ class H3IndexSystem(IndexSystem):
             out[sel] = np.maximum.reduce(
                 [np.abs(di), np.abs(dj), np.abs(di - dj)]
             )
-        return out
+        cross_face = (fa != face) | (fb != face)
+        return np.where(cross_face, np.int64(-1), out)
 
     # ------------------------------------------------------------ polyfill
     def _bbox_sample_points(
