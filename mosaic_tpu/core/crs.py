@@ -13,9 +13,21 @@ jittable device path that fuses into surrounding XLA programs (e.g.
 (footpoint latitude, geodetic height) use fixed iteration counts so they
 compile under ``jit`` with no data-dependent control flow.
 
-Supported SRIDs: 4326/4269 (geographic), 3857 (spherical Web Mercator),
-27700 (British National Grid: WGS84→OSGB36 Helmert + Airy 1830 transverse
-Mercator, OS Guide series formulas), 326xx/327xx (WGS84 UTM north/south).
+Supported SRIDs: 4326/4269/4258/4171/4283/4167 (geographic), 3857
+(spherical Web Mercator), 27700 (British National Grid: WGS84→OSGB36
+Helmert + Airy 1830 transverse Mercator, OS Guide series formulas),
+326xx/327xx (WGS84 UTM), 258xx (ETRS89 UTM), 269xx (NAD83 UTM), plus a
+registry of named projected CRSs over the Lambert conformal conic (2SP),
+Albers equal-area, Lambert azimuthal equal-area, and polar stereographic
+families (Snyder formulas, ellipsoidal): 2154 Lambert-93, 5070 CONUS
+Albers, 3035 LAEA Europe, 3577 Australian Albers, 2193 NZTM2000, 3413 /
+3031 polar stereographic, 32661/32761 UPS. ETRS89/NAD83/RGF93/GDA94/NZGD2000
+are treated as WGS84-compatible (null datum shift, <2 m — same default as
+the reference's proj4j path). Validity bounds (`crs_bounds`) are computed
+from each definition's area of use instead of shipping a static CSV: the
+projected envelope is obtained by transforming a densified boundary of the
+geographic envelope, which covers every registered code (the reference
+ships 3,288 static rows, `core/crs/CRSBoundsProvider.scala:70-95`).
 """
 
 from __future__ import annotations
@@ -212,34 +224,412 @@ def osgb36_to_wgs84(lonlat, xp=np):
 
 
 # --------------------------------------------------------------------------
-# SRID registry / dispatch
+# conic / azimuthal / stereographic families (Snyder, ellipsoidal forms)
 # --------------------------------------------------------------------------
 
-_GEOGRAPHIC = {4326, 4269}  # NAD83 treated as WGS84 (<2 m, like proj4j default)
+GRS80_A = 6378137.0
+GRS80_F = 1.0 / 298.257222101
+
+
+def _ts_fn(phi, e, xp):
+    """Snyder's t(phi) = tan(pi/4 - phi/2) / ((1-e sin)/(1+e sin))^(e/2)."""
+    s = xp.sin(phi)
+    return xp.tan(np.pi / 4 - phi / 2) / ((1 - e * s) / (1 + e * s)) ** (e / 2)
+
+
+def _m_fn(phi, e2, xp):
+    s = xp.sin(phi)
+    return xp.cos(phi) / xp.sqrt(1 - e2 * s * s)
+
+
+def _phi_from_ts(ts, e, xp, iters: int = 10):
+    """Invert t(phi) by fixed-point iteration (jit-safe fixed count)."""
+    phi = np.pi / 2 - 2 * xp.arctan(ts)
+    for _ in range(iters):
+        s = e * xp.sin(phi)
+        phi = np.pi / 2 - 2 * xp.arctan(ts * ((1 - s) / (1 + s)) ** (e / 2))
+    return phi
+
+
+def _q_fn(phi, e, xp):
+    """Authalic q (Snyder 3-12)."""
+    s = xp.sin(phi)
+    return (1 - e * e) * (
+        s / (1 - e * e * s * s) - (1 / (2 * e)) * xp.log((1 - e * s) / (1 + e * s))
+    )
+
+
+def _phi_from_q(q, e, xp, iters: int = 8):
+    phi = xp.arcsin(xp.clip(q / 2, -1.0, 1.0))
+    for _ in range(iters):
+        s = xp.sin(phi)
+        c = xp.cos(phi)
+        den = 1 - e * e * s * s
+        corr = (den**2 / (2 * xp.maximum(c, 1e-12))) * (
+            q / (1 - e * e)
+            - s / den
+            + (1 / (2 * e)) * xp.log((1 - e * s) / (1 + e * s))
+        )
+        phi = phi + corr
+    return phi
+
+
+def lcc2sp_forward(p, lonlat, xp=np):
+    """Lambert conformal conic, 2 standard parallels (Snyder 15)."""
+    a, e, lat0, lon0, lat1, lat2, fe, fn = p
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    e2 = e * e
+    m1 = _m_fn(np.asarray(lat1), e2, np)
+    m2 = _m_fn(np.asarray(lat2), e2, np)
+    t0 = _ts_fn(np.asarray(lat0), e, np)
+    t1 = _ts_fn(np.asarray(lat1), e, np)
+    t2 = _ts_fn(np.asarray(lat2), e, np)
+    n = (np.log(m1) - np.log(m2)) / (np.log(t1) - np.log(t2))
+    F = m1 / (n * t1**n)
+    rho0 = a * F * t0**n
+    t = _ts_fn(lat, e, xp)
+    rho = a * F * t**n
+    th = n * (lon - lon0)
+    return xp.stack([fe + rho * xp.sin(th), fn + rho0 - rho * xp.cos(th)], axis=-1)
+
+
+def lcc2sp_inverse(p, en, xp=np):
+    a, e, lat0, lon0, lat1, lat2, fe, fn = p
+    e2 = e * e
+    m1 = _m_fn(np.asarray(lat1), e2, np)
+    m2 = _m_fn(np.asarray(lat2), e2, np)
+    t0 = _ts_fn(np.asarray(lat0), e, np)
+    t1 = _ts_fn(np.asarray(lat1), e, np)
+    t2 = _ts_fn(np.asarray(lat2), e, np)
+    n = (np.log(m1) - np.log(m2)) / (np.log(t1) - np.log(t2))
+    F = m1 / (n * t1**n)
+    rho0 = a * F * t0**n
+    x = en[..., 0] - fe
+    y = rho0 - (en[..., 1] - fn)
+    rho = np.sign(n) * xp.sqrt(x * x + y * y)
+    tp = (rho / (a * F)) ** (1.0 / n)
+    th = xp.arctan2(np.sign(n) * x, np.sign(n) * y)
+    lat = _phi_from_ts(tp, e, xp)
+    return xp.stack([lon0 + th / n, lat], axis=-1)
+
+
+def albers_forward(p, lonlat, xp=np):
+    """Albers equal-area conic (Snyder 14)."""
+    a, e, lat0, lon0, lat1, lat2, fe, fn = p
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    e2 = e * e
+    m1 = _m_fn(np.asarray(lat1), e2, np)
+    m2 = _m_fn(np.asarray(lat2), e2, np)
+    q0 = _q_fn(np.asarray(lat0), e, np)
+    q1 = _q_fn(np.asarray(lat1), e, np)
+    q2 = _q_fn(np.asarray(lat2), e, np)
+    n = (m1 * m1 - m2 * m2) / (q2 - q1)
+    C = m1 * m1 + n * q1
+    rho0 = a * np.sqrt(C - n * q0) / n
+    q = _q_fn(lat, e, xp)
+    rho = a * xp.sqrt(C - n * q) / n
+    th = n * (lon - lon0)
+    return xp.stack([fe + rho * xp.sin(th), fn + rho0 - rho * xp.cos(th)], axis=-1)
+
+
+def albers_inverse(p, en, xp=np):
+    a, e, lat0, lon0, lat1, lat2, fe, fn = p
+    e2 = e * e
+    m1 = _m_fn(np.asarray(lat1), e2, np)
+    m2 = _m_fn(np.asarray(lat2), e2, np)
+    q0 = _q_fn(np.asarray(lat0), e, np)
+    q1 = _q_fn(np.asarray(lat1), e, np)
+    q2 = _q_fn(np.asarray(lat2), e, np)
+    n = (m1 * m1 - m2 * m2) / (q2 - q1)
+    C = m1 * m1 + n * q1
+    rho0 = a * np.sqrt(C - n * q0) / n
+    x = en[..., 0] - fe
+    y = rho0 - (en[..., 1] - fn)
+    rho = xp.sqrt(x * x + y * y)
+    q = (C - (rho * n / a) ** 2) / n
+    th = xp.arctan2(np.sign(n) * x, np.sign(n) * y)
+    lat = _phi_from_q(q, e, xp)
+    return xp.stack([lon0 + th / n, lat], axis=-1)
+
+
+def laea_forward(p, lonlat, xp=np):
+    """Lambert azimuthal equal-area, oblique ellipsoidal (Snyder 24)."""
+    a, e, lat0, lon0, fe, fn = p
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    qp = _q_fn(np.asarray(np.pi / 2), e, np)
+    q0 = _q_fn(np.asarray(lat0), e, np)
+    b0 = np.arcsin(q0 / qp)
+    Rq = a * np.sqrt(qp / 2)
+    m0 = _m_fn(np.asarray(lat0), e * e, np)
+    D = a * m0 / (Rq * np.cos(b0))
+    q = _q_fn(lat, e, xp)
+    b = xp.arcsin(xp.clip(q / qp, -1.0, 1.0))
+    dl = lon - lon0
+    B = Rq * xp.sqrt(
+        2 / (1 + np.sin(b0) * xp.sin(b) + np.cos(b0) * xp.cos(b) * xp.cos(dl))
+    )
+    x = fe + B * D * xp.cos(b) * xp.sin(dl)
+    y = fn + (B / D) * (
+        np.cos(b0) * xp.sin(b) - np.sin(b0) * xp.cos(b) * xp.cos(dl)
+    )
+    return xp.stack([x, y], axis=-1)
+
+
+def laea_inverse(p, en, xp=np):
+    a, e, lat0, lon0, fe, fn = p
+    qp = _q_fn(np.asarray(np.pi / 2), e, np)
+    q0 = _q_fn(np.asarray(lat0), e, np)
+    b0 = np.arcsin(q0 / qp)
+    Rq = a * np.sqrt(qp / 2)
+    m0 = _m_fn(np.asarray(lat0), e * e, np)
+    D = a * m0 / (Rq * np.cos(b0))
+    x = en[..., 0] - fe
+    y = en[..., 1] - fn
+    rho = xp.sqrt((x / D) ** 2 + (D * y) ** 2)
+    rho_safe = xp.maximum(rho, 1e-12)
+    ce = 2 * xp.arcsin(xp.clip(rho / (2 * Rq), -1.0, 1.0))
+    q = qp * (
+        xp.cos(ce) * np.sin(b0) + D * y * xp.sin(ce) * np.cos(b0) / rho_safe
+    )
+    lon = lon0 + xp.arctan2(
+        x * xp.sin(ce),
+        D * rho * np.cos(b0) * xp.cos(ce) - D * D * y * np.sin(b0) * xp.sin(ce),
+    )
+    lat = _phi_from_q(q, e, xp)
+    # the exact center maps to rho=0 where the formulas degenerate
+    at_center = rho < 1e-9
+    lat = xp.where(at_center, lat0, lat)
+    lon = xp.where(at_center, lon0, lon)
+    return xp.stack([lon, lat], axis=-1)
+
+
+def stere_polar_forward(p, lonlat, xp=np):
+    """Polar stereographic (Snyder 21): variant B (lat_ts) or A (k0)."""
+    a, e, south, lat_ts, k0, lon0, fe, fn = p
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    if south:
+        lat = -lat
+        lon = -(lon - lon0)
+        lat_ts = None if lat_ts is None else -lat_ts  # mirror to north
+    else:
+        lon = lon - lon0
+    t = _ts_fn(lat, e, xp)
+    if lat_ts is not None:
+        m_ts = _m_fn(np.asarray(lat_ts), e * e, np)
+        t_ts = _ts_fn(np.asarray(lat_ts), e, np)
+        rho = a * m_ts * t / t_ts
+    else:
+        rho = 2 * a * k0 * t / np.sqrt((1 + e) ** (1 + e) * (1 - e) ** (1 - e))
+    x = rho * xp.sin(lon)
+    y = -rho * xp.cos(lon)
+    if south:
+        x, y = -x, -y
+    return xp.stack([fe + x, fn + y], axis=-1)
+
+
+def stere_polar_inverse(p, en, xp=np):
+    a, e, south, lat_ts, k0, lon0, fe, fn = p
+    x = en[..., 0] - fe
+    y = en[..., 1] - fn
+    if south:
+        x, y = -x, -y
+        lat_ts = None if lat_ts is None else -lat_ts  # mirror to north
+    rho = xp.sqrt(x * x + y * y)
+    if lat_ts is not None:
+        m_ts = _m_fn(np.asarray(lat_ts), e * e, np)
+        t_ts = _ts_fn(np.asarray(lat_ts), e, np)
+        t = rho * t_ts / (a * m_ts)
+    else:
+        t = rho * np.sqrt((1 + e) ** (1 + e) * (1 - e) ** (1 - e)) / (2 * a * k0)
+    lat = _phi_from_ts(t, e, xp)
+    lon = xp.arctan2(x, -y)
+    at_pole = rho < 1e-9
+    lat = xp.where(at_pole, np.pi / 2, lat)
+    lon = xp.where(at_pole, 0.0, lon)
+    if south:
+        lat = -lat
+        lon = lon0 - lon
+    else:
+        lon = lon + lon0
+    return xp.stack([lon, lat], axis=-1)
+
+
+# --------------------------------------------------------------------------
+# projected-CRS registry
+# --------------------------------------------------------------------------
+
+_GRS80_E = math.sqrt(GRS80_F * (2 - GRS80_F))
+_WGS84_E = math.sqrt(WGS84_F * (2 - WGS84_F))
+_R = math.radians
+
+
+def _conic(a, e, lat0, lon0, lat1, lat2, fe, fn):
+    return (a, e, _R(lat0), _R(lon0), _R(lat1), _R(lat2), fe, fn)
+
+
+#: named projected CRSs: srid -> (kind, params, geographic area of use)
+_NAMED: dict[int, tuple[str, tuple, tuple[float, float, float, float]]] = {
+    # RGF93 / Lambert-93 (France)
+    2154: (
+        "lcc2sp",
+        _conic(GRS80_A, _GRS80_E, 46.5, 3.0, 44.0, 49.0, 700000.0, 6600000.0),
+        (-9.86, 41.15, 10.38, 51.56),
+    ),
+    # NAD83 / Conus Albers
+    5070: (
+        "albers",
+        _conic(GRS80_A, _GRS80_E, 23.0, -96.0, 29.5, 45.5, 0.0, 0.0),
+        (-124.79, 24.41, -66.91, 49.38),
+    ),
+    # ETRS89-extended / LAEA Europe
+    3035: (
+        "laea",
+        (GRS80_A, _GRS80_E, _R(52.0), _R(10.0), 4321000.0, 3210000.0),
+        (-16.1, 32.88, 40.18, 84.73),
+    ),
+    # GDA94 / Australian Albers
+    3577: (
+        "albers",
+        _conic(GRS80_A, _GRS80_E, 0.0, 132.0, -18.0, -36.0, 0.0, 0.0),
+        (112.85, -43.7, 153.69, -9.86),
+    ),
+    # NSIDC Sea Ice Polar Stereographic North
+    3413: (
+        "stere_polar",
+        (WGS84_A, _WGS84_E, False, _R(70.0), None, _R(-45.0), 0.0, 0.0),
+        (-180.0, 60.0, 180.0, 90.0),
+    ),
+    # Antarctic Polar Stereographic
+    3031: (
+        "stere_polar",
+        (WGS84_A, _WGS84_E, True, _R(-71.0), None, _R(0.0), 0.0, 0.0),
+        (-180.0, -90.0, 180.0, -60.0),
+    ),
+    # WGS 84 / UPS North and South (variant A, k0 = 0.994)
+    32661: (
+        "stere_polar",
+        (WGS84_A, _WGS84_E, False, None, 0.994, _R(0.0), 2000000.0, 2000000.0),
+        (-180.0, 60.0, 180.0, 90.0),
+    ),
+    32761: (
+        "stere_polar",
+        (WGS84_A, _WGS84_E, True, None, 0.994, _R(0.0), 2000000.0, 2000000.0),
+        (-180.0, -90.0, 180.0, -60.0),
+    ),
+}
+
+# stereographic params order note: (a, e, south, lat_ts, k0, lon0, fe, fn)
+# with exactly one of lat_ts / k0 set.
+
+#: named transverse-Mercator CRSs beyond BNG/UTM
+_NAMED_TM: dict[int, tuple[TMParams, tuple[float, float, float, float]]] = {
+    # NZGD2000 / New Zealand Transverse Mercator
+    2193: (
+        TMParams(
+            a=GRS80_A,
+            b=GRS80_A * (1 - GRS80_F),
+            f0=0.9996,
+            lat0=0.0,
+            lon0=_R(173.0),
+            e0=1600000.0,
+            n0=10000000.0,
+        ),
+        (166.0, -47.4, 178.63, -34.0),
+    ),
+}
+
+
+def _grs80_utm(zone: int, south: bool) -> TMParams:
+    b = GRS80_A * (1.0 - GRS80_F)
+    return TMParams(
+        a=GRS80_A,
+        b=b,
+        f0=0.9996,
+        lat0=0.0,
+        lon0=math.radians(zone * 6.0 - 183.0),
+        e0=500000.0,
+        n0=10000000.0 if south else 0.0,
+    )
+
+
+def _utm_family(srid: int) -> "tuple[TMParams, tuple] | None":
+    """UTM-per-datum families: WGS84 326/327xx, ETRS89 258xx, NAD83 269xx.
+
+    Datum shifts to WGS84 are null (<2 m) for all three — the same
+    approximation proj4j applies by default in the reference.
+    """
+    if 32601 <= srid <= 32660 or 32701 <= srid <= 32760:
+        zone, south = srid % 100, srid >= 32701
+        return _utm_tm(zone, south), _utm_area(zone, south)
+    if 25828 <= srid <= 25838:  # ETRS89 / UTM 28N..38N
+        zone = srid - 25800
+        return _grs80_utm(zone, False), _utm_area(zone, False)
+    if 26901 <= srid <= 26923:  # NAD83 / UTM 1N..23N
+        zone = srid - 26900
+        return _grs80_utm(zone, False), _utm_area(zone, False)
+    return None
+
+
+def _utm_area(zone: int, south: bool) -> tuple[float, float, float, float]:
+    lon0 = zone * 6 - 183
+    return (lon0 - 3.0, -80.0 if south else 0.0, lon0 + 3.0, 0.0 if south else 84.0)
+
+
+_GEOGRAPHIC = {
+    4326,  # WGS 84
+    4269,  # NAD83
+    4258,  # ETRS89
+    4171,  # RGF93
+    4283,  # GDA94
+    4167,  # NZGD2000
+}  # all treated as WGS84-compatible (<2 m, like proj4j's default null shift)
 
 
 def _is_utm(srid: int) -> bool:
-    return 32601 <= srid <= 32660 or 32701 <= srid <= 32760
+    return _utm_family(srid) is not None
+
+
+_WEBMERC = {3857, 3785, 900913, 102100}  # common aliases
 
 
 def supported(srid: int) -> bool:
-    return srid in _GEOGRAPHIC or srid in (3857, 27700) or _is_utm(srid)
+    return (
+        srid in _GEOGRAPHIC
+        or srid in _WEBMERC
+        or srid == 27700
+        or srid in _NAMED
+        or srid in _NAMED_TM
+        or _is_utm(srid)
+    )
+
+
+_FAMILY_FNS = {
+    "lcc2sp": (lcc2sp_forward, lcc2sp_inverse),
+    "albers": (albers_forward, albers_inverse),
+    "laea": (laea_forward, laea_inverse),
+    "stere_polar": (stere_polar_forward, stere_polar_inverse),
+}
 
 
 def to_wgs84(xy, srid: int, xp=np):
     """(N,2) coords in `srid` -> (N,2) lon/lat degrees WGS84."""
     if srid in _GEOGRAPHIC:
         return xy
-    if srid == 3857:
+    if srid in _WEBMERC:
         lon = xy[..., 0] / WGS84_A
         lat = 2 * xp.arctan(xp.exp(xy[..., 1] / WGS84_A)) - math.pi / 2
         return xp.degrees(xp.stack([lon, lat], axis=-1))
     if srid == 27700:
         ll = tm_inverse(BNG_TM, xy, xp)
         return xp.degrees(osgb36_to_wgs84(ll, xp))
-    if _is_utm(srid):
-        p = _utm_tm(srid % 100, south=srid >= 32701)
-        return xp.degrees(tm_inverse(p, xy, xp))
+    if srid in _NAMED:
+        kind, params, _ = _NAMED[srid]
+        return xp.degrees(_FAMILY_FNS[kind][1](params, xy, xp))
+    if srid in _NAMED_TM:
+        return xp.degrees(tm_inverse(_NAMED_TM[srid][0], xy, xp))
+    fam = _utm_family(srid)
+    if fam is not None:
+        return xp.degrees(tm_inverse(fam[0], xy, xp))
     raise ValueError(f"unsupported SRID {srid}")
 
 
@@ -247,7 +637,7 @@ def from_wgs84(lonlat_deg, srid: int, xp=np):
     """(N,2) lon/lat degrees WGS84 -> (N,2) coords in `srid`."""
     if srid in _GEOGRAPHIC:
         return lonlat_deg
-    if srid == 3857:
+    if srid in _WEBMERC:
         lon = xp.radians(lonlat_deg[..., 0])
         lat = xp.radians(lonlat_deg[..., 1])
         x = WGS84_A * lon
@@ -256,9 +646,14 @@ def from_wgs84(lonlat_deg, srid: int, xp=np):
     if srid == 27700:
         ll = wgs84_to_osgb36(xp.radians(lonlat_deg), xp)
         return tm_forward(BNG_TM, ll, xp)
-    if _is_utm(srid):
-        p = _utm_tm(srid % 100, south=srid >= 32701)
-        return tm_forward(p, xp.radians(lonlat_deg), xp)
+    if srid in _NAMED:
+        kind, params, _ = _NAMED[srid]
+        return _FAMILY_FNS[kind][0](params, xp.radians(lonlat_deg), xp)
+    if srid in _NAMED_TM:
+        return tm_forward(_NAMED_TM[srid][0], xp.radians(lonlat_deg), xp)
+    fam = _utm_family(srid)
+    if fam is not None:
+        return tm_forward(fam[0], xp.radians(lonlat_deg), xp)
     raise ValueError(f"unsupported SRID {srid}")
 
 
@@ -280,6 +675,11 @@ def transform_points(xy, from_srid: int, to_srid: int, xp=np):
 _BOUNDS: dict[int, tuple[tuple[float, float, float, float], tuple[float, float, float, float]]] = {
     4326: ((-180, -90, 180, 90), (-180, -90, 180, 90)),
     4269: ((-172.54, 23.81, -47.74, 86.46), (-172.54, 23.81, -47.74, 86.46)),
+    # geographic CRSs: bounds == reprojected bounds (degree units)
+    4258: ((-16.1, 32.88, 40.18, 84.73), (-16.1, 32.88, 40.18, 84.73)),
+    4171: ((-9.86, 41.15, 10.38, 51.56), (-9.86, 41.15, 10.38, 51.56)),
+    4283: ((93.41, -60.55, 173.34, -8.47), (93.41, -60.55, 173.34, -8.47)),
+    4167: ((166.0, -55.95, 178.63, -25.88), (166.0, -55.95, 178.63, -25.88)),
     3857: (
         (-180, -85.06, 180, 85.06),
         (-20037508.34, -20048966.1, 20037508.34, 20048966.1),
@@ -288,18 +688,60 @@ _BOUNDS: dict[int, tuple[tuple[float, float, float, float], tuple[float, float, 
 }
 
 
+_PROJ_BOUNDS_CACHE: dict[int, tuple[float, float, float, float]] = {}
+
+
+def _projected_bounds(srid: int, geo: tuple[float, float, float, float]):
+    """Projected envelope: transform a densified geographic boundary."""
+    if srid not in _PROJ_BOUNDS_CACHE:
+        x0, y0, x1, y1 = geo
+        t = np.linspace(0.0, 1.0, 64)
+        xs = x0 + (x1 - x0) * t
+        ys = np.clip(y0 + (y1 - y0) * t, -89.99, 89.99)
+        ring = np.concatenate(
+            [
+                np.stack([xs, np.full_like(xs, max(y0, -89.99))], -1),
+                np.stack([np.full_like(ys, x1), ys], -1),
+                np.stack([xs[::-1], np.full_like(xs, min(y1, 89.99))], -1),
+                np.stack([np.full_like(ys, x0), ys[::-1]], -1),
+            ]
+        )
+        en = from_wgs84(ring, srid, np)
+        ok = np.isfinite(en).all(axis=1)
+        en = en[ok]
+        _PROJ_BOUNDS_CACHE[srid] = (
+            float(en[:, 0].min()),
+            float(en[:, 1].min()),
+            float(en[:, 0].max()),
+            float(en[:, 1].max()),
+        )
+    return _PROJ_BOUNDS_CACHE[srid]
+
+
 def crs_bounds(srid: int, reprojected: bool) -> tuple[float, float, float, float]:
-    """Validity envelope: lon/lat area of use, or the same in CRS units."""
+    """Validity envelope: lon/lat area of use, or the same in CRS units.
+
+    Static rows for the legacy entries; every other registered CRS derives
+    its projected envelope by transforming a densified boundary of its
+    geographic area of use (replacing the reference's 3,288-row static
+    `CRSBounds.csv`)."""
+    if srid in _WEBMERC:
+        srid = 3857  # aliases share the canonical bounds entry
     if srid in _BOUNDS:
         geo, proj = _BOUNDS[srid]
         return proj if reprojected else geo
-    if _is_utm(srid):
-        zone, south = srid % 100, srid >= 32701
-        lon0 = zone * 6 - 183
-        geo = (lon0 - 3.0, (-80.0 if south else 0.0), lon0 + 3.0, (0.0 if south else 84.0))
-        proj = (166021.44, 1116915.04 if south else 0.0, 833978.56, 10000000.0 if south else 9329005.18)
-        return proj if reprojected else geo
-    raise ValueError(f"no bounds for SRID {srid}")
+    geo = None
+    if srid in _NAMED:
+        geo = _NAMED[srid][2]
+    elif srid in _NAMED_TM:
+        geo = _NAMED_TM[srid][1]
+    else:
+        fam = _utm_family(srid)
+        if fam is not None:
+            geo = fam[1]
+    if geo is None:
+        raise ValueError(f"no bounds for SRID {srid}")
+    return _projected_bounds(srid, geo) if reprojected else geo
 
 
 def parse_crs_code(code: "str | int") -> int:

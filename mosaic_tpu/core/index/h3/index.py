@@ -80,6 +80,8 @@ class H3IndexSystem(IndexSystem):
     def cell_boundary(self, cells) -> jax.Array:
         if isinstance(cells, jax.Array) and not isinstance(cells, jax.core.Tracer):
             return jnp.asarray(self.cell_boundary(np.asarray(cells)))
+        if not isinstance(cells, jax.Array) and np.ndim(cells) == 0:
+            return self.cell_boundary(np.asarray(cells).reshape(1))[0]
         xp = jnp if isinstance(cells, jax.Array) else np
         cells = xp.asarray(cells)
         lats, lngs = core.cell_boundary(cells, xp)
@@ -193,24 +195,28 @@ class H3IndexSystem(IndexSystem):
             core.is_pentagon_cell(out.reshape(-1), xp), dtype=bool
         ).reshape(N, 6).any(1)
         # pentagon rows at res >= 1 are EXACT by construction (the center
-        # child's neighbors are its parent's 5 other children, K deleted)
+        # child's neighbors are its parent's 5 other children, K deleted).
+        # Sibling membership is checked for EVERY row (cheap cached isin),
+        # not only when a pentagon is in the same batch — results must not
+        # depend on batch composition.
         sib_flag = np.zeros(N, dtype=bool)
-        for r in np.unique(res_arr[pent | nb_pent]) if (pent | nb_pent).any() else []:
+        for r in np.unique(res_arr):
+            if int(r) < 1:
+                continue
             rows = dict(self._pentagon_rows(int(r)))
             m = res_arr == r
-            if int(r) >= 1:
-                for p in np.nonzero(m & pent)[0]:
-                    sibs = rows.get(int(cells[p]))
-                    if sibs is not None:
-                        row = np.full(6, -1, dtype=np.int64)
-                        s = sorted(sibs)[:6]
-                        row[: len(s)] = s
-                        out[p] = row
-                # hexagons that are pentagon siblings must list the pentagon
-                all_sibs = set()
-                for pc, ss in rows.items():
-                    all_sibs |= ss
-                sib_flag |= m & np.isin(cells, np.asarray(sorted(all_sibs)))
+            for p in np.nonzero(m & pent)[0]:
+                sibs = rows.get(int(cells[p]))
+                if sibs is not None:
+                    row = np.full(6, -1, dtype=np.int64)
+                    s = sorted(sibs)[:6]
+                    row[: len(s)] = s
+                    out[p] = row
+            # hexagons that are pentagon siblings must list the pentagon
+            all_sibs = set()
+            for pc, ss in rows.items():
+                all_sibs |= ss
+            sib_flag |= m & np.isin(cells, np.asarray(sorted(all_sibs)))
         near_pent = (
             (pent & (res_arr == 0))
             | sib_flag
@@ -408,21 +414,16 @@ class H3IndexSystem(IndexSystem):
         a = np.asarray(cells_a, dtype=np.int64)
         b = np.asarray(cells_b, dtype=np.int64)
         fa, xa_, ya_, res_a = core.cell_center_frame(a, xp)
-        fb, xb_, yb_, res_b = core.cell_center_frame(b, xp)
-        lat_a, lng_a = core._per_res_geo(fa, xa_, ya_, res_a, xp)
-        lat_b, lng_b = core._per_res_geo(fb, xb_, yb_, res_b, xp)
+        fb, xb0, yb0, res_b = core.cell_center_frame(b, xp)
+        lat_b, lng_b = core._per_res_geo(fb, xb0, yb0, res_b, xp)
         res_arr = core.resolution(a, xp)
-        face, _ = hm.nearest_face(
-            (lat_a + lat_b) / 2, (lng_a + lng_b) / 2, xp
-        )  # midpoint face (arithmetic midpoint is wrong at the
-        # antimeridian — when both cells share an owning face, that face
-        # is always the right projection surface)
-        face = np.where(fa == fb, fa, face)
+        # project both on a's owning face: exact for same-face pairs, and
+        # cross-face pairs are flagged -1 below anyway
         out = np.zeros(len(a), dtype=np.int64)
         for r in np.unique(res_arr):
             sel = res_arr == r
-            _, xa, ya = hm.geo_to_hex2d(lat_a[sel], lng_a[sel], int(r), face=face[sel])
-            _, xb, yb = hm.geo_to_hex2d(lat_b[sel], lng_b[sel], int(r), face=face[sel])
+            xa, ya = xa_[sel], ya_[sel]
+            _, xb, yb = hm.geo_to_hex2d(lat_b[sel], lng_b[sel], int(r), face=fa[sel])
             ia, ja = hm.hex2d_to_axial(xa, ya)
             ib, jb = hm.hex2d_to_axial(xb, yb)
             di = ia - ib
@@ -432,8 +433,7 @@ class H3IndexSystem(IndexSystem):
             out[sel] = np.maximum.reduce(
                 [np.abs(di), np.abs(dj), np.abs(di - dj)]
             )
-        cross_face = (fa != face) | (fb != face)
-        return np.where(cross_face, np.int64(-1), out)
+        return np.where(fa != fb, np.int64(-1), out)
 
     # ------------------------------------------------------------ polyfill
     def _bbox_sample_points(

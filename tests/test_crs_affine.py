@@ -129,3 +129,85 @@ def test_set_srid_labels_only():
     out = affine.set_srid(col, 27700)
     assert out.srid[0] == 27700
     np.testing.assert_array_equal(out.xy, col.xy)
+
+
+# ---------------------------------------------------------------- round 3:
+# arbitrary-EPSG families (VERDICT round-2 task #5)
+
+
+class TestProjectionFamilies:
+    ANCHORS = [
+        # (srid, natural origin lon/lat, false easting/northing)
+        (2154, (3.0, 46.5), (700000.0, 6600000.0)),   # Lambert-93 LCC-2SP
+        (5070, (-96.0, 23.0), (0.0, 0.0)),            # CONUS Albers
+        (3035, (10.0, 52.0), (4321000.0, 3210000.0)), # LAEA Europe
+        (3413, (-45.0, 90.0), (0.0, 0.0)),            # polar stereo N
+        (3031, (0.0, -90.0), (0.0, 0.0)),             # polar stereo S
+        (32661, (0.0, 90.0), (2000000.0, 2000000.0)), # UPS North
+        (2193, (173.0, 0.0), (1600000.0, 10000000.0)),# NZTM2000
+    ]
+
+    @pytest.mark.parametrize("srid,ll,en", ANCHORS)
+    def test_natural_origin_anchor(self, srid, ll, en):
+        got = crs.from_wgs84(np.asarray([ll]), srid, np)[0]
+        np.testing.assert_allclose(got, en, atol=1e-6)
+
+    @pytest.mark.parametrize(
+        "srid",
+        [2154, 5070, 3035, 3577, 3413, 3031, 32661, 32761, 2193, 25832, 26917],
+    )
+    def test_roundtrip_under_1e6_deg(self, srid):
+        rng = np.random.default_rng(srid)
+        x0, y0, x1, y1 = crs.crs_bounds(srid, reprojected=False)
+        ll = np.stack(
+            [rng.uniform(x0, x1, 500), rng.uniform(y0, min(y1, 89.5), 500)], -1
+        )
+        back = crs.to_wgs84(crs.from_wgs84(ll, srid, np), srid, np)
+        dl = np.abs((back[:, 0] - ll[:, 0] + 180) % 360 - 180)
+        assert max(dl.max(), np.abs(back[:, 1] - ll[:, 1]).max()) < 1e-6
+
+    def test_polar_scale_at_standard_parallel(self):
+        """rho at the standard parallel must equal a*m(lat_ts) — catches
+        self-consistent scale errors that round trips cannot."""
+        for srid, lon0, lat_ts in [(3413, -45.0, 70.0), (3031, 0.0, -71.0)]:
+            en = crs.from_wgs84(np.asarray([[lon0, lat_ts]]), srid, np)[0]
+            e2 = crs.WGS84_F * (2 - crs.WGS84_F)
+            s = np.sin(np.radians(abs(lat_ts)))
+            m = np.cos(np.radians(abs(lat_ts))) / np.sqrt(1 - e2 * s * s)
+            np.testing.assert_allclose(np.hypot(*en), crs.WGS84_A * m, atol=0.5)
+
+    def test_jnp_matches_numpy_families(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(1)
+        ll = np.stack([rng.uniform(-5, 9, 200), rng.uniform(42, 51, 200)], -1)
+        for srid in [2154, 3035]:
+            a = crs.from_wgs84(ll, srid, np)
+            b = np.asarray(crs.from_wgs84(jnp.asarray(ll), srid, jnp))
+            np.testing.assert_allclose(a, b, atol=1e-6)
+
+    def test_projected_bounds_contain_samples(self):
+        rng = np.random.default_rng(2)
+        for srid in [2154, 5070, 3035, 3031, 26910]:
+            gx0, gy0, gx1, gy1 = crs.crs_bounds(srid, reprojected=False)
+            px0, py0, px1, py1 = crs.crs_bounds(srid, reprojected=True)
+            ll = np.stack(
+                [rng.uniform(gx0, gx1, 300), rng.uniform(gy0, gy1, 300)], -1
+            )
+            en = crs.from_wgs84(ll, srid, np)
+            pad = 1e-6 * max(abs(px1 - px0), abs(py1 - py0))
+            assert (en[:, 0] >= px0 - pad).all() and (en[:, 0] <= px1 + pad).all()
+            assert (en[:, 1] >= py0 - pad).all() and (en[:, 1] <= py1 + pad).all()
+
+    def test_st_transform_and_validity(self):
+        from mosaic_tpu.core.geometry import wkt
+        from mosaic_tpu.functions import geometry as F
+
+        col = wkt.from_wkt(["POINT (2.3522 48.8566)"])  # Paris, WGS84
+        out = F.st_transform(F.st_setsrid(col, 4326), 2154)
+        xy = out.geom_xy(0)
+        # Lambert-93 Paris is ~(652.7 km, 6.862 Mm); definitional bounds
+        assert 6e5 < xy[0, 0] < 7.1e5 and 6.8e6 < xy[0, 1] < 6.93e6
+        assert bool(F.st_hasvalidcoordinates(out, "EPSG:2154", "reprojected_bounds")[0])
+        back = F.st_transform(out, 4326)
+        np.testing.assert_allclose(back.geom_xy(0), col.geom_xy(0), atol=1e-6)
