@@ -273,8 +273,14 @@ def _parse_gdal_meta(xml: str, band: "int | None") -> dict[str, str]:
 
 
 def read_raster(path: str) -> Raster:
-    """Decode a GeoTIFF via the native engine (reference: RasterAPI.raster /
-    `MosaicRasterGDAL.readRaster:182-187`)."""
+    """Decode a raster by format (reference: RasterAPI.raster /
+    `MosaicRasterGDAL.readRaster:182-187`): GeoTIFF through the native
+    engine, GRIB2 through the pure-host decoder."""
+    low = str(path).lower()
+    if low.endswith((".grib", ".grib2", ".grb", ".grb2")):
+        from ..readers.grib2 import read_grib2
+
+        return read_grib2(str(path))
     l = _lib()
     iinfo = (ctypes.c_int64 * 7)()
     dinfo = (ctypes.c_double * 8)()

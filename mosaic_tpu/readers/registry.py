@@ -74,11 +74,32 @@ def _fmt_csv_points(path, **kw):
     )
 
 
+def _fmt_geopackage(path, **kw):
+    from .geopackage import read_geopackage
+
+    return read_geopackage(path, layer=kw.get("layer"))
+
+
+def _fmt_grib(path, **kw):
+    from .grib2 import read_grib2
+
+    return read_grib2(path)
+
+
+def _fmt_zarr(path, **kw):
+    from .zarr_store import read_zarr
+
+    return read_zarr(path, array=kw.get("array"))
+
+
 _FORMATS: dict[str, Callable] = {
     "shapefile": _fmt_shapefile,
     "geojson": _fmt_geojson,
+    "geopackage": _fmt_geopackage,
     "multi_read_ogr": _fmt_multiread,
     "gdal": _fmt_gdal,
+    "grib": _fmt_grib,
+    "zarr": _fmt_zarr,
     "raster_to_grid": _fmt_raster_to_grid,
     "csv_points": _fmt_csv_points,
 }
