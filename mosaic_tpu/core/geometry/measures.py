@@ -14,8 +14,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..types import GeometryType
-from .device import DeviceGeometry, edges, is_linear, is_point_like, is_polygonal
+from .device import DeviceGeometry, edges, is_linear, is_polygonal
 
 _BIG = 1e30
 

@@ -13,7 +13,7 @@ from typing import Sequence
 import numpy as np
 
 from ..core.types import GeometryBuilder, GeometryType, PackedGeometry
-from ._coerce import coerce, serialize, to_packed
+from ._coerce import serialize, to_packed
 
 __all__ = [
     "convert_to", "convert_to_wkt", "convert_to_wkb", "convert_to_hex",

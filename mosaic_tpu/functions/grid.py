@@ -15,8 +15,8 @@ import numpy as np
 
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, polyfill as _polyfill, tessellate as _tessellate
-from ..core.types import GeometryBuilder, GeometryType, PackedGeometry
-from ._coerce import as_points, coerce, serialize, to_packed
+from ..core.types import GeometryBuilder, GeometryType
+from ._coerce import as_points, serialize, to_packed
 
 __all__ = [
     "grid_longlatascellid", "grid_pointascellid", "grid_polyfill",

@@ -66,11 +66,16 @@ def read_geopackage(path: str, layer: str | None = None) -> VectorTable:
             layer = layers[0]
         elif layer not in layers:
             raise ValueError(f"layer {layer!r} not in {layers}")
-        geom_col, srid = con.execute(
+        row = con.execute(
             "SELECT column_name, srs_id FROM gpkg_geometry_columns "
             "WHERE table_name=?",
             (layer,),
         ).fetchone()
+        if row is None:
+            raise ValueError(
+                f"layer {layer!r} has no gpkg_geometry_columns entry"
+            )
+        geom_col, srid = row
         cols_info = con.execute(f'PRAGMA table_info("{layer}")').fetchall()
         attr_cols = [c[1] for c in cols_info if c[1] != geom_col]
         sel = ", ".join(f'"{c}"' for c in [geom_col, *attr_cols])
@@ -141,7 +146,13 @@ def write_geopackage(
             (layer, "geom", "GEOMETRY", srid, 0, 0),
         )
         names = list(table.columns)
-        col_defs = "".join(f', "{c}" REAL' for c in names)
+        numeric = {
+            c: np.issubdtype(np.asarray(table.columns[c]).dtype, np.number)
+            for c in names
+        }
+        col_defs = "".join(
+            f', "{c}" {"REAL" if numeric[c] else "TEXT"}' for c in names
+        )
         con.execute(
             f'CREATE TABLE "{layer}" (fid INTEGER PRIMARY KEY, geom BLOB{col_defs})'
         )
@@ -149,9 +160,18 @@ def write_geopackage(
         header = b"GP\x00\x01" + struct.pack("<i", srid)  # LE, no envelope
         ph = ",".join("?" * (2 + len(names)))
         for i, w in enumerate(blobs):
+            vals = [
+                float(table.columns[c][i])
+                if numeric[c]
+                else (
+                    None
+                    if table.columns[c][i] is None
+                    else str(table.columns[c][i])
+                )
+                for c in names
+            ]
             con.execute(
-                f'INSERT INTO "{layer}" VALUES ({ph})',
-                (i + 1, header + w, *[float(table.columns[c][i]) for c in names]),
+                f'INSERT INTO "{layer}" VALUES ({ph})', (i + 1, header + w, *vals)
             )
         con.commit()
     finally:

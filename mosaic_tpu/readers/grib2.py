@@ -111,6 +111,8 @@ def _decode_grib1(buf: bytes, idx: int, msg_len: int):
         di = _sm16(buf, off + 23) / 1e3
         dj = _sm16(buf, off + 25) / 1e3
     scan = buf[off + 27]
+    if scan & 0x20:
+        raise ValueError("GRIB1 j-consecutive scanning (0x20) unsupported")
     off += gds_len
     bitmap = None
     if has_bms:
@@ -178,7 +180,7 @@ def read_grib2(path: str) -> Raster:
     """All messages of a GRIB2 file -> one multi-band Raster."""
     buf = open(path, "rb").read()
     bands = []
-    gt = None
+    gts = []
     meta_rows = []
     pos = 0
     while pos < len(buf) - 16:
@@ -197,7 +199,7 @@ def read_grib2(path: str) -> Raster:
                 grid, gt1, m = _decode_grib1(buf, idx, msg1)
                 bands.append(grid)
                 meta_rows.append(m)
-                gt = gt or gt1
+                gts.append(gt1)
                 pos = idx + msg1
             else:
                 pos = idx + 4
@@ -236,6 +238,10 @@ def read_grib2(path: str) -> Raster:
                 di = _sm32(buf, off + 63) / 1e6
                 dj = _sm32(buf, off + 67) / 1e6
                 scan = buf[off + 71]
+                if scan & 0x20:
+                    raise ValueError(
+                        "GRIB2 j-consecutive scanning (0x20) unsupported"
+                    )
             elif snum == 4:
                 cat, num = buf[off + 9], buf[off + 10]
             elif snum == 5:
@@ -279,16 +285,18 @@ def read_grib2(path: str) -> Raster:
             grid = grid[:, ::-1]
         bands.append(grid.astype(np.float64))
         meta_rows.append(f"GRIB_DISCIPLINE={discipline};CAT={cat};NUM={num}")
-        gt = _grib_gt(la1, lo1, ni, nj, abs(di), abs(dj), scan)
+        gts.append(_grib_gt(la1, lo1, ni, nj, abs(di), abs(dj), scan))
         pos = idx + msg_len
     if not bands:
         raise ValueError(f"no decodable GRIB messages in {path!r}")
     shapes = {b.shape for b in bands}
-    if len(shapes) > 1:
+    uniq_gt = {tuple(round(v, 9) for v in g) for g in gts}
+    if len(shapes) > 1 or len(uniq_gt) > 1:
         raise ValueError(
-            f"GRIB messages define different grids {sorted(shapes)}; "
-            "read them as separate rasters"
+            f"GRIB messages define different grids (shapes {sorted(shapes)}, "
+            f"{len(uniq_gt)} geotransforms); read them as separate rasters"
         )
+    gt = gts[0]
     meta = "".join(
         f'<Item name="BAND_{i + 1}">{m}</Item>' for i, m in enumerate(meta_rows)
     )

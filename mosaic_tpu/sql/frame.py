@@ -10,10 +10,13 @@ columns + a PackedGeometry, and the index state is explicit fields.
 from __future__ import annotations
 
 import dataclasses
+from typing import TYPE_CHECKING
 
 import numpy as np
 
-from ..core.tessellate import ChipTable
+if TYPE_CHECKING:  # string annotations only
+    from ..core.tessellate import ChipTable  # noqa: F401
+
 from ..core.types import PackedGeometry
 from ..functions._coerce import to_packed
 

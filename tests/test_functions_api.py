@@ -9,7 +9,6 @@ for grid functions, across the three index systems (H3, BNG, CUSTOM).
 import numpy as np
 import pytest
 
-import mosaic_tpu
 from mosaic_tpu import MosaicContext
 from mosaic_tpu import functions as F
 from mosaic_tpu.core.index.bng import BNGIndexSystem

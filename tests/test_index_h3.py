@@ -11,7 +11,6 @@ import numpy as np
 import pytest
 
 from mosaic_tpu.core.index.h3 import H3IndexSystem, core, tables
-from mosaic_tpu.core.index.h3 import constants as C
 
 H3 = H3IndexSystem()
 

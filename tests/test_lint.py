@@ -1,0 +1,16 @@
+"""The lint gate runs inside the suite so every environment enforces it
+(reference analog: the scalastyle gate wired into the Maven build)."""
+
+import subprocess
+import sys
+import os
+
+
+def test_lint_gate_clean():
+    root = os.path.dirname(os.path.dirname(__file__))
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "tools", "lint.py")],
+        capture_output=True,
+        text=True,
+    )
+    assert r.returncode == 0, f"lint findings:\n{r.stdout}"

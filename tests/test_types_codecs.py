@@ -1,7 +1,6 @@
 """PackedGeometry + WKT/WKB/GeoJSON codec round-trips."""
 
 import numpy as np
-import pytest
 
 from mosaic_tpu.core.geometry import geojson, wkb, wkt
 from mosaic_tpu.core.types import GeometryType, PackedGeometry
