@@ -281,6 +281,10 @@ def read_raster(path: str) -> Raster:
         from ..readers.grib2 import read_grib2
 
         return read_grib2(str(path))
+    if low.endswith((".nc", ".nc4")):
+        from ..readers.hdf5_lite import read_netcdf
+
+        return read_netcdf(str(path))
     l = _lib()
     iinfo = (ctypes.c_int64 * 7)()
     dinfo = (ctypes.c_double * 8)()

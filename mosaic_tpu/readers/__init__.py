@@ -13,6 +13,7 @@ from .vector import read_geojson, read_shapefile, read_points_csv  # noqa: F401
 from .raster_grid import raster_to_grid, read_gdal_metadata  # noqa: F401
 from .geopackage import read_geopackage, write_geopackage  # noqa: F401
 from .grib2 import read_grib2  # noqa: F401
+from .hdf5_lite import H5Lite, read_netcdf  # noqa: F401
 from .zarr_store import ZarrStore, read_zarr  # noqa: F401
 
 __all__ = [
@@ -23,6 +24,8 @@ __all__ = [
     "read_geopackage",
     "write_geopackage",
     "read_grib2",
+    "read_netcdf",
+    "H5Lite",
     "read_zarr",
     "ZarrStore",
     "raster_to_grid",

@@ -92,6 +92,12 @@ def _fmt_zarr(path, **kw):
     return read_zarr(path, array=kw.get("array"))
 
 
+def _fmt_netcdf(path, **kw):
+    from .hdf5_lite import read_netcdf
+
+    return read_netcdf(path, variable=kw.get("variable"))
+
+
 _FORMATS: dict[str, Callable] = {
     "shapefile": _fmt_shapefile,
     "geojson": _fmt_geojson,
@@ -99,6 +105,7 @@ _FORMATS: dict[str, Callable] = {
     "multi_read_ogr": _fmt_multiread,
     "gdal": _fmt_gdal,
     "grib": _fmt_grib,
+    "netcdf": _fmt_netcdf,
     "zarr": _fmt_zarr,
     "raster_to_grid": _fmt_raster_to_grid,
     "csv_points": _fmt_csv_points,

@@ -209,3 +209,68 @@ def test_geopackage_envelope_flag_variants(tmp_path):
     con.close()
     back = read_geopackage(str(p))
     assert W.to_wkt(back.geometry) == ["POINT (7 8)"]
+
+
+# ----------------------------------------------------------- NetCDF-4/HDF5
+NC_DIR = "/root/reference/src/test/resources/binary/netcdf-coral"
+
+
+@needs_fixtures
+def test_netcdf_coral_decode():
+    """NOAA CRW 5km coral product: global 0.05-degree uint8 grids."""
+    from mosaic_tpu.readers import H5Lite
+
+    p = sorted(glob.glob(f"{NC_DIR}/*.nc"))[0]
+    h5 = H5Lite(p)
+    assert set(h5.datasets()) == {
+        "bleaching_alert_area", "crs", "lat", "lon", "mask", "time",
+    }
+    lat = h5.read("lat")
+    lon = h5.read("lon")
+    assert lat.shape == (3600,) and lon.shape == (7200,)
+    np.testing.assert_allclose(lat[0], 89.975)
+    np.testing.assert_allclose(lat[-1], -89.975)
+    np.testing.assert_allclose(lon[0], -179.975)
+    baa = h5.read("bleaching_alert_area")
+    assert baa.shape == (1, 3600, 7200) and baa.dtype == np.uint8
+    assert h5.fill_value("bleaching_alert_area") == 251
+    vals = set(np.unique(baa).tolist())
+    assert vals <= {0, 1, 2, 3, 4, 251}  # alert levels + fill
+
+
+@needs_fixtures
+def test_netcdf_all_fixture_files_consistent():
+    """Every day of the coral series decodes to the same grid."""
+    from mosaic_tpu.readers import read_netcdf
+
+    for p in sorted(glob.glob(f"{NC_DIR}/*.nc"))[:4]:
+        r = read_netcdf(p)
+        assert r.data.shape == (2, 3600, 7200)
+        # coordinate variables are f32: compare to f32 precision
+        np.testing.assert_allclose(
+            r.gt, (-180.0, 0.05, 0.0, 90.0, 0.0, -0.05), atol=1e-4
+        )
+        assert 0.5 < float(np.isfinite(r.data).mean()) <= 1.0
+
+
+@needs_fixtures
+def test_netcdf_via_read_raster_and_registry():
+    from mosaic_tpu.raster import read_raster
+
+    p = sorted(glob.glob(f"{NC_DIR}/*.nc"))[0]
+    r = read_raster(p)  # .nc extension dispatch
+    assert r.num_bands == 2
+    r2 = read("netcdf").option("variable", "mask").load(p)
+    assert r2.num_bands == 1
+    from mosaic_tpu.functions import raster as R
+
+    assert int(R.rst_width([r])[0]) == 7200
+
+
+def test_netcdf_rejects_non_hdf5(tmp_path):
+    from mosaic_tpu.readers import H5Lite
+
+    p = tmp_path / "no.nc"
+    p.write_bytes(b"CDF\x01" + b"\x00" * 64)  # netCDF-3 classic
+    with pytest.raises(ValueError):
+        H5Lite(str(p))
