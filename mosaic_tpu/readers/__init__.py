@@ -12,6 +12,7 @@ from .registry import read  # noqa: F401
 from .vector import read_geojson, read_shapefile, read_points_csv  # noqa: F401
 from .raster_grid import raster_to_grid, read_gdal_metadata  # noqa: F401
 from .geopackage import read_geopackage, write_geopackage  # noqa: F401
+from .filegdb import read_filegdb  # noqa: F401
 from .grib2 import read_grib2  # noqa: F401
 from .hdf5_lite import H5Lite, read_netcdf  # noqa: F401
 from .zarr_store import ZarrStore, read_zarr  # noqa: F401
@@ -23,6 +24,7 @@ __all__ = [
     "read_points_csv",
     "read_geopackage",
     "write_geopackage",
+    "read_filegdb",
     "read_grib2",
     "read_netcdf",
     "H5Lite",

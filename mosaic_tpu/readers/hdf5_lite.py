@@ -52,6 +52,7 @@ class H5Lite:
         else:
             raise ValueError(f"HDF5 superblock v{ver} unsupported")
         self._vars: dict[str, int] = {}
+        self._info_cache: dict[str, dict] = {}
         self._walk_group(root, "")
 
     # ------------------------------------------------------------ messages
@@ -107,6 +108,8 @@ class H5Lite:
         lflags = d[mp + 1]
         q = mp + 2
         if lflags & 0x08:
+            if d[q] != 0:  # 0 = hard link; soft/external have a path body
+                raise ValueError("soft/external HDF5 links unsupported")
             q += 1
         if lflags & 0x04:
             q += 8
@@ -236,15 +239,12 @@ class H5Lite:
 
     # ---------------------------------------------------------------- read
     def attrs(self, name: str) -> dict:
-        return self._dataset_info(name)["attrs"]
+        return self._info_cached(name)["attrs"]
 
     def _info_cached(self, name: str) -> dict:
-        cache = getattr(self, "_info_cache", None)
-        if cache is None:
-            cache = self._info_cache = {}
-        if name not in cache:
-            cache[name] = self._dataset_info(name)
-        return cache[name]
+        if name not in self._info_cache:
+            self._info_cache[name] = self._dataset_info(name)
+        return self._info_cache[name]
 
     def fill_value(self, name: str):
         info = self._info_cached(name)
@@ -345,11 +345,30 @@ def read_netcdf(path: str, variable: str | None = None):
 
     h5 = H5Lite(path)
     names = h5.datasets()
-    grids = []
+    candidates = []
     for n in names:
         shape = h5._info_cached(n)["shape"]
-        if len(shape) >= 2 and int(np.prod(shape)) > 1:
-            grids.append(n)
+        if (
+            len(shape) >= 2
+            and int(np.prod(shape)) > 1
+            and not n.split("/")[-1].endswith(("_bnds", "_bounds"))
+        ):
+            candidates.append(n)
+    # CF files carry auxiliary 2-D variables (bounds, char arrays): keep
+    # only the variables sharing the DOMINANT trailing 2-D shape
+    from collections import Counter
+
+    tails = Counter(
+        tuple(h5._info_cached(n)["shape"][-2:]) for n in candidates
+    )
+    grids = []
+    if tails:
+        best = max(tails.items(), key=lambda kv: (kv[1], kv[0][0] * kv[0][1]))[0]
+        grids = [
+            n
+            for n in candidates
+            if tuple(h5._info_cached(n)["shape"][-2:]) == best
+        ]
     if variable is not None:
         if variable not in names:
             raise ValueError(f"no variable {variable!r}; have {names}")

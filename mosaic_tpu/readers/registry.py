@@ -80,6 +80,12 @@ def _fmt_geopackage(path, **kw):
     return read_geopackage(path, layer=kw.get("layer"))
 
 
+def _fmt_geodb(path, **kw):
+    from .filegdb import read_filegdb
+
+    return read_filegdb(path, layer=kw.get("layer"))
+
+
 def _fmt_grib(path, **kw):
     from .grib2 import read_grib2
 
@@ -102,6 +108,7 @@ _FORMATS: dict[str, Callable] = {
     "shapefile": _fmt_shapefile,
     "geojson": _fmt_geojson,
     "geopackage": _fmt_geopackage,
+    "geodb": _fmt_geodb,
     "multi_read_ogr": _fmt_multiread,
     "gdal": _fmt_gdal,
     "grib": _fmt_grib,
