@@ -115,6 +115,60 @@ def _probe_platform() -> str:
         return "cpu"
 
 
+_CACHE_VERSION = 3  # bump when ChipIndex layout changes
+
+
+def _load_or_build_index(zones, zones_src: str, h3):
+    """Tessellation is pure host work recomputed identically every run
+    (~3s, ~20% of bench wall-clock noise): cache the built ChipIndex."""
+    import jax.numpy as jnp
+
+    from mosaic_tpu.core.geometry.device import DeviceGeometry
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.sql.join import ChipIndex, build_chip_index
+
+    key = f"{zones_src}-{RES}-v{_CACHE_VERSION}"
+    try:
+        st = os.stat(NYC_FIXTURE)
+        key += f"-{st.st_mtime_ns}-{st.st_size}"
+    except OSError:
+        pass
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_cache", key + ".npz")
+    import dataclasses as _dc
+
+    border_names = [f.name for f in _dc.fields(DeviceGeometry)]
+    index_names = [
+        f.name for f in _dc.fields(ChipIndex) if f.name != "border"
+    ]
+    if os.path.exists(cache):
+        try:
+            z = np.load(cache)
+            border = DeviceGeometry(
+                **{n: jnp.asarray(z[f"b_{n}"]) for n in border_names}
+            )
+            ix = ChipIndex(
+                border=border,
+                **{n: jnp.asarray(z[n]) for n in index_names},
+            )
+            return ix, True
+        except Exception:
+            pass  # stale/corrupt cache: rebuild
+    table = tessellate(zones, h3, RES, keep_core_geoms=False)
+    index = build_chip_index(table)
+    try:
+        os.makedirs(os.path.dirname(cache), exist_ok=True)
+        np.savez_compressed(
+            cache,
+            **{n: np.asarray(getattr(index, n)) for n in index_names},
+            **{f"b_{n}": np.asarray(getattr(index.border, n))
+               for n in border_names},
+        )
+    except OSError:
+        pass
+    return index, False
+
+
 def _load_zones():
     """Reference NYC taxi-zone fixture if readable, else synthetic twins."""
     try:
@@ -171,12 +225,14 @@ def main():
             float(np.nanmax(b[:, 3])),
         )
         t0 = time.perf_counter()
-        table = tessellate(zones, h3, RES, keep_core_geoms=False)
+        index, cache_hit = _load_or_build_index(zones, zones_src, h3)
+        # on a hit this is npz-load time, NOT tessellation speed — the
+        # flag keeps cross-round comparisons honest
         detail["tessellate_s"] = round(time.perf_counter() - t0, 2)
-        index = build_chip_index(table)
+        detail["tessellate_cache_hit"] = cache_hit
         detail.update(
             n_zones=len(zones),
-            n_chips=len(table),
+            n_chips=int(index.chip_geom.shape[0]),
             h3_res=RES,
             zones=zones_src,
             n_heavy_cells=index.num_heavy_cells,

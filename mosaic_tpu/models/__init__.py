@@ -1,11 +1,16 @@
 """Models & services (reference: `models/` — SpatialKNN + core transformers)."""
 
-from .core import CheckpointManager, IterativeTransformer  # noqa: F401
+from .core import (  # noqa: F401
+    BinaryTransformer,
+    CheckpointManager,
+    IterativeTransformer,
+)
 from .knn import GridRingNeighbours, SpatialKNN  # noqa: F401
 
 __all__ = [
     "CheckpointManager",
     "IterativeTransformer",
+    "BinaryTransformer",
     "GridRingNeighbours",
     "SpatialKNN",
 ]

@@ -35,10 +35,12 @@ class CheckpointManager:
     def _parts(self) -> list[Path]:
         return sorted(self.dir.glob("part-*.npz"))
 
-    def append(self, table: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    def append(self, table: dict[str, np.ndarray]) -> None:
+        """Write one part (call `load()` to materialize the union —
+        appending used to return it, which made every append re-read all
+        prior parts: O(n^2) I/O over an iteration loop)."""
         n = len(self._parts())
         np.savez(self.dir / f"part-{n:05d}.npz", **table)
-        return self.load()
 
     def overwrite(self, table: dict[str, np.ndarray]) -> None:
         for p in self._parts():
@@ -91,3 +93,37 @@ class IterativeTransformer:
                 break
             prev = state
         return state
+
+
+class BinaryTransformer(IterativeTransformer):
+    """Left/right two-table iterative transformer (reference:
+    `models/core/BinaryTransformer.scala` — the skeleton `SpatialKNN`-style
+    models build on: a fixed RIGHT table joined against an evolving LEFT
+    state each iteration).
+
+    ``join_step(left_state, right, iteration)`` produces the next left
+    state; the right side is threaded unchanged (and may live on device —
+    e.g. a replicated :class:`~mosaic_tpu.sql.join.ChipIndex`)."""
+
+    def __init__(
+        self,
+        join_step: Callable,
+        should_stop: Callable,
+        max_iterations: int,
+        right=None,
+        checkpoint: "CheckpointManager | None" = None,
+    ):
+        self.right = right
+        self.checkpoint = checkpoint
+
+        def step(left, i):
+            out = join_step(left, self.right, i)
+            if self.checkpoint is not None:
+                self.checkpoint.append({"iteration": np.asarray([i])})
+            return out
+
+        super().__init__(step, should_stop, max_iterations)
+
+    def transform(self, left):
+        """Run the iteration from an initial left state (ML-style verb)."""
+        return self.iterate(left)

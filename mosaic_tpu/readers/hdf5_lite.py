@@ -363,7 +363,9 @@ def read_netcdf(path: str, variable: str | None = None):
     )
     grids = []
     if tails:
-        best = max(tails.items(), key=lambda kv: (kv[1], kv[0][0] * kv[0][1]))[0]
+        # largest grid wins (aux char arrays / station tables are small);
+        # count only breaks ties between equal-sized grids
+        best = max(tails.items(), key=lambda kv: (kv[0][0] * kv[0][1], kv[1]))[0]
         grids = [
             n
             for n in candidates

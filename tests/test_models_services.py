@@ -31,10 +31,10 @@ def _fresh_context():
 def test_checkpoint_manager(tmp_path):
     ck = CheckpointManager(str(tmp_path / "ck"))
     t1 = {"a": np.arange(3), "b": np.ones(3)}
-    out = ck.append(t1)
-    assert out["a"].tolist() == [0, 1, 2]
-    out = ck.append({"a": np.arange(3, 5), "b": np.zeros(2)})
-    assert out["a"].tolist() == [0, 1, 2, 3, 4]
+    ck.append(t1)
+    assert ck.load()["a"].tolist() == [0, 1, 2]
+    ck.append({"a": np.arange(3, 5), "b": np.zeros(2)})
+    assert ck.load()["a"].tolist() == [0, 1, 2, 3, 4]
     ck.overwrite({"a": np.array([9]), "b": np.array([9.0])})
     assert ck.load()["a"].tolist() == [9]
     ck.write_meta({"k": 5})
@@ -191,3 +191,47 @@ def test_spatial_knn_threshold_and_early_stop():
     assert (res.distance <= 0.01).all()
     d = np.linalg.norm(cand_pts - np.array([-74.0, 40.7]), axis=-1)
     assert res.metrics["match_count"] <= int((d <= 0.01).sum())
+
+
+def test_binary_transformer_threads_right_side(tmp_path):
+    """Reference: `models/core/BinaryTransformer.scala` — fixed right table
+    joined against an evolving left state each iteration."""
+    import numpy as np
+
+    from mosaic_tpu.models import BinaryTransformer, CheckpointManager
+
+    right = np.asarray([1.0, 2.0, 3.0])
+
+    def join_step(left, r, i):
+        return left + r.sum()  # each iteration folds the right side in
+
+    ck = CheckpointManager(str(tmp_path / "bt"))
+    bt = BinaryTransformer(
+        join_step,
+        should_stop=lambda prev, cur: cur >= 18,
+        max_iterations=10,
+        right=right,
+        checkpoint=ck,
+    )
+    out = bt.transform(0.0)
+    assert out == 18.0 and bt.iterations_run == 3
+
+
+def test_r_bindings_generated_and_complete():
+    """The generated R package must cover every registered name
+    (reference analog: R/generate_R_bindings.R output)."""
+    import os
+    import re
+
+    import mosaic_tpu
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(__file__)), "R", "mosaicTpu", "R",
+        "functions.R",
+    )
+    assert os.path.exists(path), "run tools/generate_r_bindings.py"
+    src = open(path).read()
+    exported = set(re.findall(r"^([a-z_0-9]+) <- function", src, re.M))
+    registered = set(mosaic_tpu.MosaicContext.build("H3").register())
+    missing = registered - exported
+    assert not missing, f"R bindings missing: {sorted(missing)}"
