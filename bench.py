@@ -127,12 +127,11 @@ def _load_or_build_index(zones, zones_src: str, h3):
     from mosaic_tpu.core.tessellate import tessellate
     from mosaic_tpu.sql.join import ChipIndex, build_chip_index
 
-    key = f"{zones_src}-{RES}-v{_CACHE_VERSION}"
-    try:
-        st = os.stat(NYC_FIXTURE)
-        key += f"-{st.st_mtime_ns}-{st.st_size}"
-    except OSError:
-        pass
+    import zlib
+
+    xy = np.ascontiguousarray(np.asarray(zones.xy, dtype=np.float64))
+    fp = zlib.crc32(xy.tobytes()) ^ zlib.crc32(bytes(str(len(zones)), "ascii"))
+    key = f"{zones_src}-{RES}-v{_CACHE_VERSION}-{fp:08x}"
     cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".bench_cache", key + ".npz")
     import dataclasses as _dc
@@ -197,9 +196,8 @@ def main():
         import jax.numpy as jnp
 
         from mosaic_tpu.core.index.h3 import H3IndexSystem
-        from mosaic_tpu.core.tessellate import tessellate
-        from mosaic_tpu.datasets import NYC_BBOX, random_points
-        from mosaic_tpu.sql.join import build_chip_index, pip_join_points
+        from mosaic_tpu.datasets import random_points
+        from mosaic_tpu.sql.join import pip_join_points
 
         detail["device"] = str(jax.devices()[0])
         on_tpu = jax.devices()[0].platform not in ("cpu",)

@@ -108,7 +108,9 @@ def _pip_zone_kernel(
         bx = planes_ref[2, t, :][:, None, None]
         by = planes_ref[3, t, :][:, None, None]
         straddle = (ay > py) != (by > py)
-        denom = jnp.where(by == ay, 1.0, by - ay)
+        # ones_like, not the literal 1.0: under x64 a python float lowers
+        # as f64 and Mosaic has no f64->f32 cast on TPU
+        denom = jnp.where(by == ay, jnp.ones_like(by), by - ay)
         xcross = ax + (py - ay) * (bx - ax) / denom
         hit = straddle & (px < xcross)
         return acc + hit.astype(jnp.int32)

@@ -119,7 +119,14 @@ class BinaryTransformer(IterativeTransformer):
         def step(left, i):
             out = join_step(left, self.right, i)
             if self.checkpoint is not None:
-                self.checkpoint.append({"iteration": np.asarray([i])})
+                part = {"iteration": np.asarray([i])}
+                if isinstance(out, np.ndarray):
+                    part["left"] = out  # recoverable state, not just a counter
+                elif isinstance(out, dict) and all(
+                    isinstance(v, np.ndarray) for v in out.values()
+                ):
+                    part.update(out)
+                self.checkpoint.append(part)
             return out
 
         super().__init__(step, should_stop, max_iterations)

@@ -235,3 +235,5 @@ def test_r_bindings_generated_and_complete():
     registered = set(mosaic_tpu.MosaicContext.build("H3").register())
     missing = registered - exported
     assert not missing, f"R bindings missing: {sorted(missing)}"
+    stale = exported - registered - {"enableMosaic"}
+    assert not stale, f"stale R bindings for removed names: {sorted(stale)}"
