@@ -74,7 +74,8 @@ AP7_ROT_RADS = 0.333473172251832115336090755351601070065900704
 RES0_U_GNOMONIC = 0.38196601125010500003
 
 SQRT7 = 7.0**0.5
-SIN60 = np.sqrt(3.0) / 2.0
+SIN60 = float(np.sqrt(3.0) / 2.0)  # Python float: np.float64 scalars are
+# strongly typed and would promote an f32 device batch to emulated f64
 MAX_RES = 15
 NUM_BASE_CELLS = 122
 NUM_FACES = 20

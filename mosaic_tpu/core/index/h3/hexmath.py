@@ -18,6 +18,21 @@ from . import constants as C
 _EPS = 1e-12
 
 
+def _f(table, like, xp):
+    """Float constant table in the *input's* dtype on device.
+
+    Host numpy stays f64. On device the tables would otherwise be f64
+    (x64 is enabled globally) and silently promote an f32 batch to
+    emulated-f64 trig on TPU — measured 7x slower than the same pipeline
+    in f32 (bench round 3)."""
+    if xp is np:
+        return table
+    dt = like.dtype if hasattr(like, "dtype") else None
+    if dt is not None and np.issubdtype(dt, np.floating):
+        return xp.asarray(table, dtype=dt)
+    return xp.asarray(table)
+
+
 # --------------------------------------------------------------------- geo
 def geo_to_vec3(lat, lng, xp=np):
     cl = xp.cos(lat)
@@ -183,9 +198,37 @@ def is_class_iii(res) -> bool:
 def nearest_face(lat, lng, xp=np):
     """Face whose center is closest (max dot product). (...,) int."""
     v = geo_to_vec3(lat, lng, xp)  # (...,3)
-    fc = _FACE_CENTER_VEC3 if xp is np else xp.asarray(_FACE_CENTER_VEC3)
-    dots = v @ fc.T  # (...,20)
+    fc = _f(_FACE_CENTER_VEC3, lat, xp)
+    if xp is np:
+        dots = v @ fc.T  # (...,20)
+    else:
+        # explicit FMA broadcast instead of matmul: exact f32 on the VPU
+        # (the MXU's default bf16 products would flip faces near the
+        # face-boundary bisector) and fully fusable
+        dots = (
+            v[..., 0, None] * fc[None, :, 0]
+            + v[..., 1, None] * fc[None, :, 1]
+            + v[..., 2, None] * fc[None, :, 2]
+        )
     return xp.argmax(dots, axis=-1), xp.clip(xp.max(dots, axis=-1), -1.0, 1.0)
+
+
+def select_rows(idx, table, n_rows: int, xp):
+    """``table[idx]`` without a TPU gather: a select-chain over the row
+    axis. Data-dependent gathers serialize on TPU (measured ~83 ms per
+    (4M,) gather from a 540-entry table, ~20x the whole trig pipeline);
+    an unrolled where-chain over a *small* static row count is pure
+    fused VPU work.
+
+    idx: (...,) int; table: (n_rows, ...) ndarray constant. Returns
+    table.dtype values shaped idx.shape + table.shape[1:].
+    """
+    tab = np.asarray(table)
+    out = xp.zeros(idx.shape + tab.shape[1:], dtype=tab.dtype)
+    ex = idx[(...,) + (None,) * (tab.ndim - 1)]
+    for r in range(n_rows):
+        out = xp.where(ex == r, xp.asarray(tab[r]), out)
+    return out
 
 
 def geo_to_hex2d(lat, lng, res: int, face=None, xp=np):
@@ -193,20 +236,29 @@ def geo_to_hex2d(lat, lng, res: int, face=None, xp=np):
 
     If ``face`` is None the nearest face is used (returned alongside x, y).
     """
+    face_given = face is not None
     if face is None:
         face, cosdist = nearest_face(lat, lng, xp)
         r = xp.arccos(cosdist)
+    if xp is np:
+        flat = C.FACE_CENTER_GEO[face, 0]
+        flng = C.FACE_CENTER_GEO[face, 1]
+        azif = C.FACE_AXES_AZ_I[face]
     else:
-        fc_geo = C.FACE_CENTER_GEO if xp is np else xp.asarray(C.FACE_CENTER_GEO)
-        flat, flng = fc_geo[face, 0], fc_geo[face, 1]
+        # one select-chain instead of three per-point gathers
+        dt = lat.dtype if hasattr(lat, "dtype") else np.float64
+        geo_tab = np.stack(
+            [C.FACE_CENTER_GEO[:, 0], C.FACE_CENTER_GEO[:, 1], C.FACE_AXES_AZ_I],
+            axis=1,
+        ).astype(dt)
+        f3 = select_rows(face, geo_tab, 20, xp)
+        flat, flng, azif = f3[..., 0], f3[..., 1], f3[..., 2]
+    if face_given:
         v = geo_to_vec3(lat, lng, xp)
         fv = geo_to_vec3(flat, flng, xp)
         r = xp.arccos(xp.clip(xp.sum(v * fv, axis=-1), -1.0, 1.0))
-    fc_geo = C.FACE_CENTER_GEO if xp is np else xp.asarray(C.FACE_CENTER_GEO)
-    az_i = C.FACE_AXES_AZ_I if xp is np else xp.asarray(C.FACE_AXES_AZ_I)
-    flat, flng = fc_geo[face, 0], fc_geo[face, 1]
     az = geo_azimuth(flat, flng, lat, lng, xp)
-    theta = pos_angle(az_i[face] - pos_angle(az, xp), xp)
+    theta = pos_angle(azif - pos_angle(az, xp), xp)
     if is_class_iii(res):
         theta = pos_angle(theta - C.AP7_ROT_RADS, xp)
     rr = xp.tan(r) / C.RES0_U_GNOMONIC
@@ -228,8 +280,8 @@ def hex2d_to_geo(face, x, y, res: int, substrate: bool = False, xp=np):
     r = xp.arctan(r * C.RES0_U_GNOMONIC)
     if not substrate and is_class_iii(res):
         theta = pos_angle(theta + C.AP7_ROT_RADS, xp)
-    az_i = C.FACE_AXES_AZ_I if xp is np else xp.asarray(C.FACE_AXES_AZ_I)
-    fc_geo = C.FACE_CENTER_GEO if xp is np else xp.asarray(C.FACE_CENTER_GEO)
+    az_i = _f(C.FACE_AXES_AZ_I, x, xp)
+    fc_geo = _f(C.FACE_CENTER_GEO, x, xp)
     az = pos_angle(az_i[face] - pos_angle(theta, xp), xp)
     return geo_az_distance(fc_geo[face, 0], fc_geo[face, 1], az, r, xp)
 

@@ -119,13 +119,17 @@ class BinaryTransformer(IterativeTransformer):
         def step(left, i):
             out = join_step(left, self.right, i)
             if self.checkpoint is not None:
-                part = {"iteration": np.asarray([i])}
-                if isinstance(out, np.ndarray):
-                    part["left"] = out  # recoverable state, not just a counter
-                elif isinstance(out, dict) and all(
-                    isinstance(v, np.ndarray) for v in out.values()
-                ):
-                    part.update(out)
+                # np.asarray also pulls device (jax.Array) states to host so
+                # the checkpoint really is recoverable, not counter-only
+                if isinstance(out, dict):
+                    part = {
+                        k: np.asarray(v)
+                        for k, v in out.items()
+                        if k != "iteration"
+                    }
+                else:
+                    part = {"left": np.asarray(out)}
+                part["iteration"] = np.asarray([i])
                 self.checkpoint.append(part)
             return out
 
