@@ -8,17 +8,20 @@ streamed through the grid so arbitrarily large polygon tables tile cleanly.
 
 TPU layout (satisfies the (8, 128) f32 tile constraint):
 
-- points ride as ``[rows, 128]`` blocks — sublanes x lanes are both point
-  dims, so every vreg is full;
+- points ride as ``[tile_n, 1]`` column blocks (sublane axis), polygons
+  on the lane axis — so each (point, polygon) pair is one element of a
+  ``[tile_n, tile_g]`` vreg tile and every edge step is an elementwise
+  sublane-x-lane broadcast, with no layout casts (the previous 3-D
+  design needed a lane->leading ``tpu.reshape`` Mosaic cannot infer a
+  vector layout for);
 - polygon edges are ``[4, E_pad, G_pad]`` coordinate planes whose blocks
-  are ``[4, tile_e, tile_g]`` (``tile_e`` sublane-, ``tile_g``
-  lane-aligned);
-- the per-(polygon, point) crossing accumulator is a 3-D
-  ``[tile_g, rows, 128]`` VMEM scratch — polygon index is the leading
-  (vreg-count) dim, so each edge step is pure element-wise vector math;
+  are ``[4, tile_e, tile_g]``: slicing one edge row yields a ``[1,
+  tile_g]`` lane vector that broadcasts against the point column;
+- the crossing-parity accumulator is a 2-D ``[tile_n, tile_g]`` VMEM
+  scratch;
 - the grid is (point_blocks, g_blocks, e_blocks) with edges innermost;
-  the output block is revisited across g/e and min-accumulated, so HBM
-  output stays O(N).
+  the output block is revisited across g/e and min-accumulated (lane
+  reduction at the last edge block), so HBM output stays O(N).
 
 The jnp reference implementation (`core.geometry.predicates.contains_xy`)
 is the interpreted oracle; tests assert agreement (SURVEY.md §4(b)).
@@ -37,6 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ..core.geometry.device import DeviceGeometry
 
 _BIG_F = 1e30
+_I0 = np.int32(0)  # index-map literal: a python 0 traces as i64 under x64
 _SENT = 2**30  # python int: jnp scalars would be captured as kernel consts
 
 
@@ -99,35 +103,42 @@ def _pip_zone_kernel(
     def _():
         cnt[:] = jnp.zeros_like(cnt)
 
-    px = px_ref[:][None, :, :]  # (1, rows, 128)
-    py = py_ref[:][None, :, :]
+    px = px_ref[:]  # (tile_n, 1)
+    py = py_ref[:]
 
     def body(t, acc):
-        ax = planes_ref[0, t, :][:, None, None]  # (tile_g, 1, 1)
-        ay = planes_ref[1, t, :][:, None, None]
-        bx = planes_ref[2, t, :][:, None, None]
-        by = planes_ref[3, t, :][:, None, None]
-        straddle = (ay > py) != (by > py)
+        ax = planes_ref[0, t, :][None, :]  # (1, tile_g)
+        ay = planes_ref[1, t, :][None, :]
+        bx = planes_ref[2, t, :][None, :]
+        by = planes_ref[3, t, :][None, :]
+        straddle = (ay > py) != (by > py)  # (tile_n, tile_g)
         # ones_like, not the literal 1.0: under x64 a python float lowers
-        # as f64 and Mosaic has no f64->f32 cast on TPU
+        # as f64 and Mosaic has no f64->f32 cast on TPU.
+        # slope is divided on the (1, tile_g) edge vector, not per
+        # (point, polygon) element — division is the costliest VPU op.
         denom = jnp.where(by == ay, jnp.ones_like(by), by - ay)
-        xcross = ax + (py - ay) * (bx - ax) / denom
+        slope = (bx - ax) / denom
+        xcross = ax + (py - ay) * slope
         hit = straddle & (px < xcross)
         return acc + hit.astype(jnp.int32)
 
-    cnt[:] = jax.lax.fori_loop(0, tile_e, body, cnt[:])
+    # int32 bounds: under global x64 a python-int bound makes an i64
+    # induction variable, which Mosaic cannot legalize on TPU
+    cnt[:] = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(tile_e), body, cnt[:]
+    )
 
     @pl.when(e_blk == n_e - 1)
     def _():
         inside = (cnt[:] & 1) == 1
         gid = (
-            jax.lax.broadcasted_iota(jnp.int32, cnt.shape, 0)
+            jax.lax.broadcasted_iota(jnp.int32, cnt.shape, 1)
             + g_blk * tile_g
         )
         valid = inside & (gid < n_real_g)
         best = jnp.min(
-            jnp.where(valid, gid, jnp.int32(_SENT)), axis=0
-        )  # (rows, 128)
+            jnp.where(valid, gid, jnp.int32(_SENT)), axis=1, keepdims=True
+        )  # (tile_n, 1)
         out_ref[:] = jnp.minimum(out_ref[:], best)
 
 
@@ -146,18 +157,18 @@ def pip_zone(
     """For each point, the id of the first polygon containing it, else -1.
 
     points: (N, 2); planes: (4, E, G) from :func:`edge_planes`.
-    ``tile_n`` must be a multiple of 1024 (8 sublanes x 128 lanes of f32),
-    ``tile_g`` a multiple of 128; E and G are padded here if needed.
+    ``tile_n`` must be a multiple of 8 (the point block is a (tile_n, 1)
+    sublane column), ``tile_g`` a multiple of 128; E and G are padded
+    here if needed.
     """
     if n_real_g is None:
         n_real_g = planes.shape[2]
-    if tile_n % 1024:
-        raise ValueError(f"tile_n must be a multiple of 1024, got {tile_n}")
+    if tile_n % 8:
+        raise ValueError(f"tile_n must be a multiple of 8, got {tile_n}")
     N = points.shape[0]
     n_pad = ((N + tile_n - 1) // tile_n) * tile_n
-    rows = tile_n // 128
-    px = _pad_to(points[:, 0], n_pad, 0, _BIG_F).reshape(-1, 128)
-    py = _pad_to(points[:, 1], n_pad, 0, _BIG_F).reshape(-1, 128)
+    px = _pad_to(points[:, 0], n_pad, 0, _BIG_F).reshape(-1, 1)
+    py = _pad_to(points[:, 1], n_pad, 0, _BIG_F).reshape(-1, 1)
     E, G = planes.shape[1], planes.shape[2]
     pad_vals = jnp.array([0.0, _BIG_F, 0.0, _BIG_F], planes.dtype)[:, None, None]
     if E % tile_e:
@@ -182,22 +193,22 @@ def pip_zone(
         grid=(n_blocks, n_g, n_e),
         in_specs=[
             pl.BlockSpec(
-                (rows, 128), lambda i, g, e: (i, 0), memory_space=pltpu.VMEM
+                (tile_n, 1), lambda i, g, e: (i, _I0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (rows, 128), lambda i, g, e: (i, 0), memory_space=pltpu.VMEM
+                (tile_n, 1), lambda i, g, e: (i, _I0), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
                 (4, tile_e, tile_g),
-                lambda i, g, e: (0, e, g),
+                lambda i, g, e: (_I0, e, g),
                 memory_space=pltpu.VMEM,
             ),
         ],
         out_specs=pl.BlockSpec(
-            (rows, 128), lambda i, g, e: (i, 0), memory_space=pltpu.VMEM
+            (tile_n, 1), lambda i, g, e: (i, _I0), memory_space=pltpu.VMEM
         ),
-        out_shape=jax.ShapeDtypeStruct((n_pad // 128, 128), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((tile_g, rows, 128), jnp.int32)],
+        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((tile_n, tile_g), jnp.int32)],
         interpret=interpret,
     )(px, py, planes)
     out = out.reshape(-1)[:N]

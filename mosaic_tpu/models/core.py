@@ -120,15 +120,23 @@ class BinaryTransformer(IterativeTransformer):
             out = join_step(left, self.right, i)
             if self.checkpoint is not None:
                 # np.asarray also pulls device (jax.Array) states to host so
-                # the checkpoint really is recoverable, not counter-only
+                # the checkpoint really is recoverable, not counter-only;
+                # atleast_1d because load() concatenates columns and 0-d
+                # arrays (scalar states) cannot be concatenated
+                def _col(v):
+                    try:
+                        return np.atleast_1d(np.asarray(v))
+                    except Exception:
+                        return None
+
                 if isinstance(out, dict):
-                    part = {
-                        k: np.asarray(v)
-                        for k, v in out.items()
-                        if k != "iteration"
+                    cols = {
+                        k: _col(v) for k, v in out.items() if k != "iteration"
                     }
+                    part = {k: v for k, v in cols.items() if v is not None}
                 else:
-                    part = {"left": np.asarray(out)}
+                    left = _col(out)
+                    part = {} if left is None else {"left": left}
                 part["iteration"] = np.asarray([i])
                 self.checkpoint.append(part)
             return out
