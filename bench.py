@@ -115,7 +115,7 @@ def _probe_platform() -> str:
         return "cpu"
 
 
-_CACHE_VERSION = 3  # bump when ChipIndex layout changes
+_CACHE_VERSION = 4  # bump when ChipIndex layout changes
 
 
 def _load_or_build_index(zones, zones_src: str, h3):
@@ -262,8 +262,16 @@ def main():
                 found_cap=found_cap,
             )
 
+        def bucket(n):
+            """128k-multiple buckets above 128k (pow2 below): tighter than
+            pure pow2 — a 530k estimate caps at 640k, not 1M, and cap size
+            directly scales the tier-1 gather and scatter-back cost."""
+            if n <= 131072:
+                return max(16, 1 << int(np.ceil(np.log2(n + 1))))
+            return (n + 131071) // 131072 * 131072
+
         def caps_for(cnp, margin, clamp):
-            """Pow2-bucketed compaction caps from host-side counts, with a
+            """Bucketed compaction caps from host-side counts, with a
             safety margin so one presample sizes every batch (an overflow
             (-2) in any output triggers a redo at doubled caps)."""
             pos = np.clip(
@@ -271,16 +279,12 @@ def main():
             )
             fnp = index_cells[pos] == cnp
             n_found = int(fnp.sum() * margin)
-            fcap = min(
-                max(16, 1 << int(np.ceil(np.log2(n_found + 1)))), clamp
-            )
+            fcap = min(bucket(n_found), clamp)
             hcap = None
             if index.num_heavy_cells:
                 hmask = np.asarray(index.cell_heavy) >= 0
                 n_heavy = int(np.isin(cnp[fnp], index_cells[hmask]).sum() * margin)
-                hcap = min(
-                    max(16, 1 << int(np.ceil(np.log2(n_heavy + 1)))), fcap
-                )
+                hcap = min(bucket(n_heavy), fcap)
             return fcap, hcap, float(fnp.mean())
 
         # size the compaction caps once from a host presample (the timed
@@ -288,7 +292,7 @@ def main():
         batch = min(4_000_000, n_device)
         pre = np.asarray(cells_of(jnp.asarray(pts[:n_base])))
         fcap, hcap, ffrac = caps_for(
-            pre, margin=2.0 * batch / n_base, clamp=batch
+            pre, margin=1.5 * batch / n_base, clamp=batch
         )
 
         # warm up compile on one batch; on compile failure halve the batch

@@ -123,20 +123,25 @@ class BinaryTransformer(IterativeTransformer):
                 # the checkpoint really is recoverable, not counter-only;
                 # atleast_1d because load() concatenates columns and 0-d
                 # arrays (scalar states) cannot be concatenated
-                def _col(v):
+                def _col(name, v):
                     try:
                         return np.atleast_1d(np.asarray(v))
-                    except Exception:
-                        return None
+                    except Exception as e:
+                        # a dropped column would make parts key-inconsistent
+                        # and break (or silently thin) load() on restore
+                        raise TypeError(
+                            f"checkpointed state {name!r} is not "
+                            f"array-convertible: {e}"
+                        ) from e
 
                 if isinstance(out, dict):
-                    cols = {
-                        k: _col(v) for k, v in out.items() if k != "iteration"
+                    part = {
+                        k: _col(k, v)
+                        for k, v in out.items()
+                        if k != "iteration"
                     }
-                    part = {k: v for k, v in cols.items() if v is not None}
                 else:
-                    left = _col(out)
-                    part = {} if left is None else {"left": left}
+                    part = {"left": _col("left", out)}
                 part["iteration"] = np.asarray([i])
                 self.checkpoint.append(part)
             return out

@@ -98,6 +98,8 @@ def pad_index_for_shards(index: ChipIndex, shards: int) -> ChipIndex:
         hash_mult=index.hash_mult,
         table_cell=index.table_cell,
         table_slot=index.table_slot,
+        table_pack=index.table_pack,
+        pack_low=index.pack_low,
         cell_edges=pad0(index.cell_edges, du),
         cell_ebits=pad0(index.cell_ebits, du),
         cell_slot_geom=pad0(index.cell_slot_geom, du, -1),
@@ -132,6 +134,8 @@ def _index_specs(spec, table_spec) -> ChipIndex:
         hash_mult=P(),
         table_cell=table_spec,
         table_slot=table_spec,
+        table_pack=table_spec,
+        pack_low=P(),
         cell_edges=spec,
         cell_ebits=spec,
         cell_slot_geom=spec,
@@ -160,6 +164,11 @@ def _gather_index(idx: ChipIndex, axis_name: str, table_sharded: bool) -> ChipIn
         idx,
         table_cell=g(idx.table_cell) if table_sharded else idx.table_cell,
         table_slot=g(idx.table_slot) if table_sharded else idx.table_slot,
+        table_pack=(
+            g(idx.table_pack)
+            if table_sharded and idx.table_pack.shape[0]
+            else idx.table_pack
+        ),
         cell_edges=g(idx.cell_edges),
         cell_ebits=g(idx.cell_ebits),
         cell_slot_geom=g(idx.cell_slot_geom),

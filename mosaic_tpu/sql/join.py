@@ -82,6 +82,13 @@ class ChipIndex:
     table_cell: (T, B) int64 — bucketed hash table of cell ids (-1 empty);
                 T is a power of two, B the max bucket occupancy.
     table_slot: (T, B) int32 — cell slot u for each bucket entry (-1 empty).
+    table_pack: (T, B) int64 — slot-packed probe table: when every indexed
+                cell shares its low ``k`` bits (H3 at a fixed resolution
+                keeps the unused finer digits constant), entry =
+                ``(cell & ~low) | (slot + 1)`` and the probe needs ONE
+                gather instead of two. (0, 0) when packing is impossible
+                (too few constant bits); probe falls back to the pair.
+    pack_low:   (2,) int64 — [low-bit mask, constant low-bit value].
 
     Tier-1 flat edge probe (light cells):
 
@@ -108,6 +115,8 @@ class ChipIndex:
     hash_mult: jax.Array
     table_cell: jax.Array
     table_slot: jax.Array
+    table_pack: jax.Array
+    pack_low: jax.Array
     cell_edges: jax.Array
     cell_ebits: jax.Array
     cell_slot_geom: jax.Array
@@ -161,7 +170,26 @@ def _build_hash(cells: np.ndarray, max_bucket: int = 8):
         table_cell[k, fill[k]] = c
         table_slot[k, fill[k]] = u
         fill[k] += 1
-    return mult, table_cell, table_slot
+
+    # slot-packed variant: if all cells share their low k bits (H3 at a
+    # fixed res keeps the unused finer digits constant) and slot+1 fits in
+    # k bits, one int64 entry carries both the cell and the slot — the
+    # device probe then needs a single (N, B) gather instead of two
+    table_pack = np.zeros((0, 0), dtype=np.int64)
+    pack_low = np.zeros(2, dtype=np.int64)
+    if U:
+        diff = np.bitwise_or.reduce(cells ^ cells[0])
+        k_bits = int(diff & -diff).bit_length() - 1 if diff else 63
+        k_bits = min(k_bits, 62)
+        if k_bits > 0 and (U + 1) < (1 << k_bits):
+            low = np.int64((1 << k_bits) - 1)
+            table_pack = np.where(
+                table_slot >= 0,
+                (table_cell & ~low) | (table_slot.astype(np.int64) + 1),
+                np.int64(0),
+            )
+            pack_low = np.asarray([low, cells[0] & low], dtype=np.int64)
+    return mult, table_cell, table_slot, table_pack, pack_low
 
 
 def _round8(n: int, lo: int = 8) -> int:
@@ -216,7 +244,7 @@ def build_chip_index(
     border = pack_to_device(chips, dtype=dtype, recenter=recenter)
 
     # probe fast path: hash table + flat per-cell edge rows
-    mult, table_cell, table_slot = _build_hash(uniq)
+    mult, table_cell, table_slot, table_pack, pack_low = _build_hash(uniq)
 
     from ..core.types import GeometryType
 
@@ -334,6 +362,8 @@ def build_chip_index(
         hash_mult=jnp.asarray(np.asarray([mult], dtype=np.uint64)),
         table_cell=jnp.asarray(table_cell),
         table_slot=jnp.asarray(table_slot),
+        table_pack=jnp.asarray(table_pack),
+        pack_low=jnp.asarray(pack_low),
         cell_edges=jnp.asarray(cell_edges),
         cell_ebits=jnp.asarray(cell_ebits),
         cell_slot_geom=jnp.asarray(slot_geom),
@@ -380,22 +410,62 @@ def _slot_best(parity, geoms, cores=None):
     return jnp.min(jnp.where(hit, geoms, _SENTINEL), axis=-1)
 
 
+_SCAN_COLS = 2048
+
+
+def _prefix_inclusive(flag_i32: jax.Array) -> jax.Array:
+    """Inclusive prefix sum of (N,) 0/1 int32, N >= 1.
+
+    `jnp.cumsum` lowers to an XLA reduce-window that costs ~22 ms for 4M
+    elements on v5e; a row-reshaped prefix by upper-triangular-ones matmul
+    runs on the MXU in ~2 ms. f32 HIGHEST keeps counts exact only below
+    2^24, so batches that could overflow fall back to the exact cumsum
+    (as do small batches, where the matmul setup dominates).
+    """
+    n = flag_i32.shape[0]
+    if n < 4 * _SCAN_COLS or n >= (1 << 24):
+        return jnp.cumsum(flag_i32)
+    c = _SCAN_COLS
+    r = (n + c - 1) // c
+    # device-built mask: a module-level numpy constant would bake 16 MB
+    # into every executable that traces this
+    tri = (
+        jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
+        <= jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
+    ).astype(jnp.float32)
+    x = jnp.zeros(r * c, jnp.float32).at[: n].set(flag_i32.astype(jnp.float32))
+    x2 = x.reshape(r, c)
+    p = jax.lax.dot(x2, tri, precision=jax.lax.Precision.HIGHEST)
+    rowsum = p[:, -1]
+    rowoff = jnp.cumsum(rowsum) - rowsum
+    return (p + rowoff[:, None]).reshape(-1)[:n].astype(jnp.int32)
+
+
 def _compact(flag: jax.Array, cap: int):
     """Stream-compact: indices of up-to-``cap`` True rows (static shape).
 
     Returns (src (cap,) int32, valid (cap,) bool, overflow (N,) bool):
-    ``src`` lists the first ``cap`` flagged row ids (padded arbitrarily,
+    ``src`` lists the first ``cap`` flagged row ids (padded with 0,
     masked by ``valid``); ``overflow`` marks flagged rows beyond ``cap``.
+
+    The scatter writes min(row id) per slot with *sorted* destination
+    indices: every row writes to clip(pos, 0, cap) — non-flagged rows
+    land on the slot of the previous flagged row with a SENTINEL value
+    that loses the min — so the index stream is monotone, which lets XLA
+    use the fast sorted-scatter path on TPU.
     """
     n = flag.shape[0]
-    pos = jnp.cumsum(flag.astype(jnp.int32)) - 1
-    dest = jnp.where(flag & (pos < cap), pos, cap)
+    incl = _prefix_inclusive(flag.astype(jnp.int32))
+    pos = incl - flag.astype(jnp.int32)  # exclusive prefix
+    dest = jnp.clip(pos, 0, cap)
+    vals = jnp.where(flag, jnp.arange(n, dtype=jnp.int32), _SENTINEL)
     src = (
-        jnp.zeros(cap + 1, dtype=jnp.int32)
+        jnp.full(cap + 1, _SENTINEL, dtype=jnp.int32)
         .at[dest]
-        .set(jnp.arange(n, dtype=jnp.int32))[:cap]
+        .min(vals, indices_are_sorted=True, mode="drop")[:cap]
     )
-    count = jnp.sum(flag.astype(jnp.int32))
+    src = jnp.where(src == _SENTINEL, 0, src)
+    count = incl[-1]
     valid = jnp.arange(cap, dtype=jnp.int32) < count
     return src, valid, flag & (pos >= cap)
 
@@ -429,10 +499,22 @@ def pip_join_points(
     key = (
         (pcells.astype(jnp.uint64) * index.hash_mult[0]) >> shift_bits
     ).astype(jnp.int32)
-    cand_cell = index.table_cell[key]  # (N, B)
-    cand_slot = index.table_slot[key]  # (N, B)
-    match = (cand_cell == pcells[:, None]) & (cand_slot >= 0)
-    u = jnp.max(jnp.where(match, cand_slot, -1), axis=1)  # (N,)
+    if index.table_pack.shape[0]:
+        # slot-packed probe: one (N, B) gather carries cell + slot
+        low = index.pack_low[0]
+        ent = index.table_pack[key]  # (N, B)
+        slotp = (ent & low).astype(jnp.int32)
+        match = (
+            (((ent ^ pcells[:, None]) & ~low) == 0)
+            & (slotp > 0)
+            & ((pcells[:, None] & low) == index.pack_low[1])
+        )
+        u = jnp.max(jnp.where(match, slotp - 1, -1), axis=1)  # (N,)
+    else:
+        cand_cell = index.table_cell[key]  # (N, B)
+        cand_slot = index.table_slot[key]  # (N, B)
+        match = (cand_cell == pcells[:, None]) & (cand_slot >= 0)
+        u = jnp.max(jnp.where(match, cand_slot, -1), axis=1)  # (N,)
     found = u >= 0
 
     K1 = int(found_cap) if found_cap else N

@@ -21,7 +21,7 @@ def test_build_hash_exhausted_retries_stay_consistent():
     cells = np.sort(np.unique(np.random.default_rng(1).integers(
         1, 2**60, 500, dtype=np.int64
     )))
-    mult, table_cell, table_slot = _build_hash(cells, max_bucket=0)
+    mult, table_cell, table_slot, _, _ = _build_hash(cells, max_bucket=0)
     T = table_cell.shape[0]
     bits = int(np.log2(T))
     keys = (cells.astype(np.uint64) * mult) >> np.uint64(64 - bits)
