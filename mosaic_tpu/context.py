@@ -57,15 +57,16 @@ class MosaicConfig:
     (`package.scala:20-25`)."""
 
     index_system: str = "H3"
-    geometry_backend: str = "device"  # 'device' (JAX) | 'oracle' (host f64)
+    geometry_backend: str = "device"  # 'device' (JAX) | 'oracle' (host
+    # f64) | 'native' (independent C++ second engine, ESRI-engine role)
     cell_id_type: str = "long"  # 'long' | 'string'
     raster_checkpoint: str = "/tmp/mosaic_tpu/raster_checkpoint"
 
     def __post_init__(self):
-        if self.geometry_backend not in ("device", "oracle"):
+        if self.geometry_backend not in ("device", "oracle", "native"):
             raise ValueError(
-                f"geometry_backend must be 'device' or 'oracle', got "
-                f"{self.geometry_backend!r}"
+                f"geometry_backend must be 'device', 'oracle' or "
+                f"'native', got {self.geometry_backend!r}"
             )
         if self.cell_id_type not in ("long", "string"):
             raise ValueError(

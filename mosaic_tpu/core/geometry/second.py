@@ -1,0 +1,185 @@
+"""Second geometry engine bindings — the ESRI-engine role.
+
+The reference ships two complete geometry engines (JTS and ESRI,
+`core/geometry/api/GeometryAPI.scala:24-60`) and its tests cross-check
+expression results between them. This module is that second engine for
+mosaic_tpu: an independent C++ implementation (`native/src/evalgeom.cpp` —
+separate language, Kahan-compensated numerics, half-open edge rule) of the
+core measures and predicates, exposed with the same per-geometry API shape
+as :mod:`mosaic_tpu.core.geometry.oracle` so tests and the ``native``
+function backend can swap it in directly.
+
+Selectable API-wide via ``MosaicConfig(geometry_backend="native")`` —
+functions without a native implementation fall back to the numpy oracle
+(documented per function in `functions/geometry.py`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from ..types import GeometryType, PackedGeometry
+from . import hostops
+
+_c_dpp = ctypes.POINTER(ctypes.c_double)
+_c_lpp = ctypes.POINTER(ctypes.c_int64)
+_c_u8p = ctypes.POINTER(ctypes.c_uint8)
+
+_proto = False
+
+
+def _lib() -> ctypes.CDLL:
+    """The shared native library with the eval entry points declared."""
+    global _proto
+    l = hostops.lib()
+    if not _proto:
+        l.mg_eval_polygon.restype = ctypes.c_int
+        l.mg_eval_polygon.argtypes = [
+            _c_dpp, _c_lpp, ctypes.c_int64, _c_u8p, _c_dpp,
+        ]
+        l.mg_eval_length.restype = ctypes.c_int
+        l.mg_eval_length.argtypes = [_c_dpp, _c_lpp, ctypes.c_int64, _c_dpp]
+        l.mg_eval_bounds.restype = ctypes.c_int
+        l.mg_eval_bounds.argtypes = [_c_dpp, ctypes.c_int64, _c_dpp]
+        l.mg_eval_contains.restype = ctypes.c_int
+        l.mg_eval_contains.argtypes = [
+            _c_dpp, _c_lpp, ctypes.c_int64, _c_dpp, ctypes.c_int64, _c_u8p,
+        ]
+        l.mg_eval_distance.restype = ctypes.c_int
+        l.mg_eval_distance.argtypes = [
+            _c_dpp, _c_lpp, ctypes.c_int64, _c_dpp, ctypes.c_int64, _c_dpp,
+        ]
+        _proto = True
+    return l
+
+
+def _geom_contours(col: PackedGeometry, g: int):
+    """(xy (V,2) f64, ring_off (R+1,) i64, is_hole (R,) u8) of geometry g.
+
+    Marshaling reuses hostops' flattening; only the hole flags (first ring
+    of each part = shell) are collected here."""
+    holes = [
+        1 if k > 0 else 0
+        for p in col.geom_parts(g)
+        for k, _ in enumerate(col.part_rings(p))
+    ]
+    xy, ro = hostops._flatten(hostops._geom_rings(col, g))
+    return xy, ro, np.asarray(holes, dtype=np.uint8)
+
+
+def _poly4(col: PackedGeometry, g: int) -> np.ndarray:
+    xy, ro, hole = _geom_contours(col, g)
+    out = np.full(4, np.nan)
+    if ro.shape[0] > 1:
+        _lib().mg_eval_polygon(
+            xy.ctypes.data_as(_c_dpp),
+            ro.ctypes.data_as(_c_lpp),
+            ctypes.c_int64(ro.shape[0] - 1),
+            hole.ctypes.data_as(_c_u8p),
+            out.ctypes.data_as(_c_dpp),
+        )
+    else:
+        out[:] = (0.0, 0.0, np.nan, np.nan)
+    return out
+
+
+def _is_poly(col: PackedGeometry, g: int) -> bool:
+    return col.geometry_type(g).base == GeometryType.POLYGON
+
+
+def area(col: PackedGeometry) -> np.ndarray:
+    """Polygon area, holes subtracted; 0 for non-polygonal rows."""
+    return np.asarray(
+        [_poly4(col, g)[0] if _is_poly(col, g) else 0.0 for g in range(len(col))]
+    )
+
+
+def centroid(col: PackedGeometry) -> np.ndarray:
+    """Area-weighted centroid for polygons; vertex/segment means (host
+    numpy, same as the oracle — the C engine covers the polygonal case)
+    for points and lines."""
+    from . import oracle as _oracle
+
+    out = np.zeros((len(col), 2))
+    for g in range(len(col)):
+        if _is_poly(col, g):
+            out[g] = _poly4(col, g)[2:4]
+        else:
+            out[g] = _oracle.centroid(col.slice(g, g + 1))[0]
+    return out
+
+
+def length(col: PackedGeometry) -> np.ndarray:
+    """Perimeter for polygons, chain length for lines, 0 for points —
+    the `st_length` contract."""
+    l = _lib()
+    out = np.zeros(len(col))
+    for g in range(len(col)):
+        base = col.geometry_type(g).base
+        if base == GeometryType.POINT:
+            continue
+        if base == GeometryType.POLYGON:
+            out[g] = _poly4(col, g)[1]
+            continue
+        xy, ro, _ = _geom_contours(col, g)
+        if ro.shape[0] <= 1:
+            continue
+        v = np.zeros(1)
+        l.mg_eval_length(
+            xy.ctypes.data_as(_c_dpp),
+            ro.ctypes.data_as(_c_lpp),
+            ctypes.c_int64(ro.shape[0] - 1),
+            v.ctypes.data_as(_c_dpp),
+        )
+        out[g] = v[0]
+    return out
+
+
+def bounds(col: PackedGeometry) -> np.ndarray:
+    l = _lib()
+    out = np.full((len(col), 4), np.nan)
+    for g in range(len(col)):
+        xy, _, _ = _geom_contours(col, g)
+        if not xy.shape[0]:
+            continue
+        l.mg_eval_bounds(
+            xy.ctypes.data_as(_c_dpp),
+            ctypes.c_int64(xy.shape[0]),
+            out[g].ctypes.data_as(_c_dpp),
+        )
+    return out
+
+
+def contains_points(col: PackedGeometry, g: int, pts: np.ndarray) -> np.ndarray:
+    xy, ro, _ = _geom_contours(col, g)
+    p = np.ascontiguousarray(np.asarray(pts, dtype=np.float64))
+    out = np.zeros(p.shape[0], np.uint8)
+    if ro.shape[0] > 1 and p.shape[0]:
+        _lib().mg_eval_contains(
+            xy.ctypes.data_as(_c_dpp),
+            ro.ctypes.data_as(_c_lpp),
+            ctypes.c_int64(ro.shape[0] - 1),
+            p.ctypes.data_as(_c_dpp),
+            ctypes.c_int64(p.shape[0]),
+            out.ctypes.data_as(_c_u8p),
+        )
+    return out.astype(bool)
+
+
+def point_distance(col: PackedGeometry, g: int, pts: np.ndarray) -> np.ndarray:
+    """Distance from each point to geometry g (0 inside)."""
+    xy, ro, _ = _geom_contours(col, g)
+    p = np.ascontiguousarray(np.asarray(pts, dtype=np.float64))
+    out = np.full(p.shape[0], np.nan)
+    if ro.shape[0] > 1 and p.shape[0]:
+        _lib().mg_eval_distance(
+            xy.ctypes.data_as(_c_dpp),
+            ro.ctypes.data_as(_c_lpp),
+            ctypes.c_int64(ro.shape[0] - 1),
+            p.ctypes.data_as(_c_dpp),
+            ctypes.c_int64(p.shape[0]),
+            out.ctypes.data_as(_c_dpp),
+        )
+    return out

@@ -23,6 +23,7 @@ from ..core.geometry import affine as _affine
 from ..core.geometry import hostops as _host
 from ..core.geometry import measures as _meas
 from ..core.geometry import oracle as _oracle
+from ..core.geometry import second as _second
 from ..core.geometry import predicates as _pred
 from ..core.geometry.device import DeviceGeometry, pack_to_device
 from ..core.types import GeometryBuilder, GeometryType, PackedGeometry
@@ -67,16 +68,22 @@ def _shift(dg: DeviceGeometry) -> np.ndarray:
 def st_area(geom, backend: str | None = None) -> np.ndarray:
     """Planar area per row (reference: ST_Area.scala:20-55)."""
     col = to_packed(geom)
-    if _resolve_backend(backend) == "oracle":
+    b = _resolve_backend(backend)
+    if b == "oracle":
         return _oracle.area(col)
+    if b == "native":
+        return _second.area(col)
     return np.asarray(_meas.area(_dev(col)), dtype=np.float64)
 
 
 def st_length(geom, backend: str | None = None) -> np.ndarray:
     """Length / perimeter per row (reference: ST_Length == ST_Perimeter)."""
     col = to_packed(geom)
-    if _resolve_backend(backend) == "oracle":
+    b = _resolve_backend(backend)
+    if b == "oracle":
         return _oracle.length(col)
+    if b == "native":
+        return _second.length(col)
     return np.asarray(_meas.length(_dev(col)), dtype=np.float64)
 
 
@@ -86,8 +93,11 @@ st_perimeter = st_length
 def st_centroid(geom, backend: str | None = None):
     """Centroid as a POINT column, serialized like the input."""
     col, fmt = coerce(geom)
-    if _resolve_backend(backend) == "oracle":
+    b = _resolve_backend(backend)
+    if b == "oracle":
         cxy = _oracle.centroid(col)
+    elif b == "native":
+        cxy = _second.centroid(col)
     else:
         dg = _dev(col)
         cxy = np.asarray(_meas.centroid(dg), dtype=np.float64) + _shift(dg)
@@ -98,8 +108,11 @@ def st_centroid(geom, backend: str | None = None):
 
 
 def _bounds(col: PackedGeometry, backend: str | None) -> np.ndarray:
-    if _resolve_backend(backend) == "oracle":
+    b = _resolve_backend(backend)
+    if b == "oracle":
         return col.bounds()
+    if b == "native":
+        return _second.bounds(col)
     dg = _dev(col)
     s = _shift(dg)
     return np.asarray(_meas.bounds(dg), dtype=np.float64) + np.concatenate([s, s])
@@ -272,7 +285,7 @@ def st_contains(geom_a, geom_b, backend: str | None = None) -> np.ndarray:
     """Row-wise a contains b (reference: ST_Contains / the PIP join
     predicate, `core/geometry/MosaicGeometryJTS.scala:101`)."""
     a, b = to_packed(geom_a), to_packed(geom_b)
-    if _resolve_backend(backend) == "oracle":
+    if _resolve_backend(backend) in ("oracle", "native"):
         return _oracle_pair_contains(a, b)
     da, db = _pair_pack(a, b)
     return np.asarray(_vmap_pair(_contains_dense, da, db))
@@ -281,7 +294,7 @@ def st_contains(geom_a, geom_b, backend: str | None = None) -> np.ndarray:
 def st_intersects(geom_a, geom_b, backend: str | None = None) -> np.ndarray:
     """Row-wise intersects (reference: ST_Intersects)."""
     a, b = to_packed(geom_a), to_packed(geom_b)
-    if _resolve_backend(backend) == "oracle":
+    if _resolve_backend(backend) in ("oracle", "native"):
         return _oracle_pair_intersects(a, b)
     da, db = _pair_pack(a, b)
     return np.asarray(_vmap_pair(_pred.intersects, da, db))
@@ -296,7 +309,7 @@ def _distance_dense(a: DeviceGeometry, b: DeviceGeometry) -> jax.Array:
 def st_distance(geom_a, geom_b, backend: str | None = None) -> np.ndarray:
     """Row-wise euclidean distance, 0 when touching/overlapping/nested."""
     a, b = to_packed(geom_a), to_packed(geom_b)
-    if _resolve_backend(backend) == "oracle":
+    if _resolve_backend(backend) in ("oracle", "native"):
         return _oracle_pair_distance(a, b)
     da, db = _pair_pack(a, b)
     return np.asarray(_vmap_pair(_distance_dense, da, db), dtype=np.float64)

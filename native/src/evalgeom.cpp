@@ -1,0 +1,185 @@
+// Second geometry engine (C++, from scratch) — the ESRI-engine role of the
+// reference's dual-engine contract (`core/geometry/api/GeometryAPI.scala`:
+// the reference ships JTS *and* ESRI implementations of every geometry op
+// and its tests cross-check them). Here the independent pair is: the
+// jitted JAX device kernels / numpy oracle (same repo, same author, shared
+// conventions) vs THIS file — separate language, separate algorithms,
+// separate numerics (Kahan-compensated accumulation, half-open edge rule),
+// consumed through the C ABI by `core/geometry/second.py` and cross-checked
+// in `tests/test_second_engine.py`.
+//
+// Exchange format matches capi.cpp: flat contour lists (double* xy, 2*nv;
+// int64* ring_off, nr+1). Holes are passed explicitly (uint8* is_hole) —
+// membership tests ignore the flags (even-odd parity handles holes), the
+// signed measures use them.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+
+namespace mgeval {
+
+struct Kahan {
+  double s = 0, c = 0;
+  inline void add(double v) {
+    double y = v - c;
+    double t = s + y;
+    c = (t - s) - y;
+    s = t;
+  }
+};
+
+// twice the signed area of one closed contour (last->first edge implied)
+static double contourArea2(const double* xy, int64_t lo, int64_t hi) {
+  Kahan k;
+  int64_t n = hi - lo;
+  if (n < 3) return 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t j = (i + 1) % n;
+    double x0 = xy[2 * (lo + i)], y0 = xy[2 * (lo + i) + 1];
+    double x1 = xy[2 * (lo + j)], y1 = xy[2 * (lo + j) + 1];
+    k.add(x0 * y1 - x1 * y0);
+  }
+  return k.s;
+}
+
+// even-odd crossing parity of one point against every contour, half-open
+// edge rule (y0 <= py < y1) so shared vertices count once
+static bool evenOddInside(const double* xy, const int64_t* ro, int64_t nr,
+                          double px, double py) {
+  bool in = false;
+  for (int64_t r = 0; r < nr; ++r) {
+    int64_t lo = ro[r], hi = ro[r + 1], n = hi - lo;
+    if (n < 3) continue;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t j = (i + 1) % n;
+      double x0 = xy[2 * (lo + i)], y0 = xy[2 * (lo + i) + 1];
+      double x1 = xy[2 * (lo + j)], y1 = xy[2 * (lo + j) + 1];
+      if ((y0 <= py) != (y1 <= py)) {
+        double xc = x0 + (py - y0) / (y1 - y0) * (x1 - x0);
+        if (px < xc) in = !in;
+      }
+    }
+  }
+  return in;
+}
+
+static double segDist2(double px, double py, double x0, double y0, double x1,
+                       double y1) {
+  double dx = x1 - x0, dy = y1 - y0;
+  double L2 = dx * dx + dy * dy;
+  double t = L2 > 0 ? ((px - x0) * dx + (py - y0) * dy) / L2 : 0.0;
+  t = t < 0 ? 0 : (t > 1 ? 1 : t);
+  double qx = x0 + t * dx - px, qy = y0 + t * dy - py;
+  return qx * qx + qy * qy;
+}
+
+}  // namespace mgeval
+
+extern "C" {
+
+// area (holes negative), perimeter, and area-weighted centroid of one
+// polygonal geometry. out = {area, perimeter, cx, cy}. rc 0 = ok.
+int mg_eval_polygon(const double* xy, const int64_t* ro, int64_t nr,
+                    const uint8_t* is_hole, double* out) {
+  using mgeval::Kahan;
+  Kahan area2, perim, cx6, cy6;
+  for (int64_t r = 0; r < nr; ++r) {
+    int64_t lo = ro[r], hi = ro[r + 1], n = hi - lo;
+    if (n < 3) continue;
+    double a2 = mgeval::contourArea2(xy, lo, hi);
+    // normalize to positive, then sign by the hole flag — independent of
+    // stored ring orientation
+    double sgn = (is_hole && is_hole[r]) ? -1.0 : 1.0;
+    double orient = a2 >= 0 ? 1.0 : -1.0;
+    area2.add(sgn * orient * a2);
+    Kahan mx, my;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t j = (i + 1) % n;
+      double x0 = xy[2 * (lo + i)], y0 = xy[2 * (lo + i) + 1];
+      double x1 = xy[2 * (lo + j)], y1 = xy[2 * (lo + j) + 1];
+      double cross = x0 * y1 - x1 * y0;
+      mx.add((x0 + x1) * cross);
+      my.add((y0 + y1) * cross);
+      perim.add(std::hypot(x1 - x0, y1 - y0));
+    }
+    cx6.add(sgn * orient * mx.s);
+    cy6.add(sgn * orient * my.s);
+  }
+  double area = 0.5 * area2.s;
+  out[0] = area;
+  out[1] = perim.s;
+  if (area != 0) {
+    out[2] = cx6.s / (6.0 * area);
+    out[3] = cy6.s / (6.0 * area);
+  } else {
+    out[2] = out[3] = NAN;
+  }
+  return 0;
+}
+
+// total polyline length of open chains
+int mg_eval_length(const double* xy, const int64_t* ro, int64_t nr,
+                   double* out) {
+  mgeval::Kahan k;
+  for (int64_t r = 0; r < nr; ++r) {
+    for (int64_t i = ro[r]; i + 1 < ro[r + 1]; ++i)
+      k.add(std::hypot(xy[2 * (i + 1)] - xy[2 * i],
+                       xy[2 * (i + 1) + 1] - xy[2 * i + 1]));
+  }
+  *out = k.s;
+  return 0;
+}
+
+int mg_eval_bounds(const double* xy, int64_t nv, double* out) {
+  double xmin = INFINITY, ymin = INFINITY, xmax = -INFINITY, ymax = -INFINITY;
+  for (int64_t i = 0; i < nv; ++i) {
+    double x = xy[2 * i], y = xy[2 * i + 1];
+    xmin = x < xmin ? x : xmin;
+    xmax = x > xmax ? x : xmax;
+    ymin = y < ymin ? y : ymin;
+    ymax = y > ymax ? y : ymax;
+  }
+  out[0] = xmin;
+  out[1] = ymin;
+  out[2] = xmax;
+  out[3] = ymax;
+  return 0;
+}
+
+// even-odd point-in-polygon for npts points; out[i] in {0, 1}
+int mg_eval_contains(const double* xy, const int64_t* ro, int64_t nr,
+                     const double* pts, int64_t npts, uint8_t* out) {
+  for (int64_t i = 0; i < npts; ++i)
+    out[i] = mgeval::evenOddInside(xy, ro, nr, pts[2 * i], pts[2 * i + 1])
+                 ? 1
+                 : 0;
+  return 0;
+}
+
+// point -> polygon distance: 0 inside, else min distance to any edge
+int mg_eval_distance(const double* xy, const int64_t* ro, int64_t nr,
+                     const double* pts, int64_t npts, double* out) {
+  for (int64_t i = 0; i < npts; ++i) {
+    double px = pts[2 * i], py = pts[2 * i + 1];
+    if (mgeval::evenOddInside(xy, ro, nr, px, py)) {
+      out[i] = 0.0;
+      continue;
+    }
+    double d2 = INFINITY;
+    for (int64_t r = 0; r < nr; ++r) {
+      int64_t lo = ro[r], hi = ro[r + 1], n = hi - lo;
+      for (int64_t k = 0; k < n; ++k) {
+        int64_t j = (k + 1) % n;
+        double v = mgeval::segDist2(px, py, xy[2 * (lo + k)],
+                                    xy[2 * (lo + k) + 1], xy[2 * (lo + j)],
+                                    xy[2 * (lo + j) + 1]);
+        d2 = v < d2 ? v : d2;
+      }
+    }
+    out[i] = std::isfinite(d2) ? std::sqrt(d2) : NAN;
+  }
+  return 0;
+}
+
+}  // extern "C"
