@@ -1,0 +1,124 @@
+"""Reference-fixture golden snapshots of the two headline workloads:
+tessellation chip structure and the PIP join result.
+
+Reference analog: the reference pins tessellation outputs against checked-in
+expected tables (`MosaicFrameBehaviors` / Quickstart cell counts); here the
+NYC taxi-zone fixture (the reference's own test resource) is tessellated and
+joined once, and structural digests — chip counts, core/border split, area
+conservation, per-zone match counts, a match-array checksum — are snapshotted
+in `tests/goldens/workload.json`.
+
+Regenerate intentionally with MOSAIC_UPDATE_GOLDENS=1 after an algorithm
+change; an unexpected diff is a correctness regression in tessellation,
+indexing, or the join probe.
+"""
+
+import json
+import os
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.index.h3 import H3IndexSystem
+from mosaic_tpu.core.tessellate import tessellate
+from mosaic_tpu.sql.join import build_chip_index, pip_join
+
+GOLDEN = Path(__file__).parent / "goldens" / "workload.json"
+NYC = "/root/reference/src/test/resources/NYC_Taxi_Zones.geojson"
+RES = 8  # one level coarser than the bench: fast enough for CI
+
+
+@pytest.fixture(scope="module")
+def zones():
+    try:
+        from mosaic_tpu.readers.vector import read_geojson
+
+        col = read_geojson(NYC).geometry
+    except Exception:
+        pytest.skip("reference NYC fixture unavailable")
+    if not len(col):
+        pytest.skip("reference NYC fixture empty")
+    return col
+
+
+@pytest.fixture(scope="module")
+def table(zones):
+    # keep_core_geoms so every chip row carries its polygon and the area
+    # conservation check can integrate core + border uniformly
+    return tessellate(zones, H3IndexSystem(), RES, keep_core_geoms=True)
+
+
+@pytest.fixture(scope="module")
+def digests(zones, table):
+    return _digests(zones, table)
+
+
+def _digests(zones, table):
+    from mosaic_tpu.core.geometry import oracle
+
+    is_core = np.asarray(table.is_core)
+    geom_id = np.asarray(table.geom_id)
+    per_zone = np.bincount(geom_id, minlength=len(zones))
+
+    # area conservation: chips of each zone must tile the zone
+    h3 = H3IndexSystem()
+    chip_area = np.zeros(len(zones))
+    np.add.at(chip_area, geom_id, oracle.area(table.chips))
+    zone_area = oracle.area(zones)
+    rel_err = float(
+        np.max(np.abs(chip_area - zone_area) / np.maximum(zone_area, 1e-12))
+    )
+
+    # seeded join over the zone bbox
+    b = zones.bounds()
+    bbox = (
+        float(np.nanmin(b[:, 0])),
+        float(np.nanmin(b[:, 1])),
+        float(np.nanmax(b[:, 2])),
+        float(np.nanmax(b[:, 3])),
+    )
+    rng = np.random.default_rng(42)
+    pts = np.stack(
+        [
+            rng.uniform(bbox[0], bbox[2], 20_000),
+            rng.uniform(bbox[1], bbox[3], 20_000),
+        ],
+        axis=1,
+    )
+    index = build_chip_index(table)
+    match = pip_join(pts, zones, h3, RES, chip_index=index)
+    match_per_zone = np.bincount(match[match >= 0], minlength=len(zones))
+
+    return {
+        "n_zones": int(len(zones)),
+        "n_chips": int(len(table)),
+        "n_core": int(is_core.sum()),
+        "n_border": int((~is_core).sum()),
+        "chips_per_zone": per_zone.tolist(),
+        # raw float kept out of the exact-equality golden (summation-order
+        # noise across backends); the bound test enforces the invariant
+        "_rel_err": rel_err,
+        "area_conservation_ok": bool(rel_err < 1e-6),
+        "join_matched": int((match >= 0).sum()),
+        "join_per_zone": match_per_zone.tolist(),
+        "join_checksum": int(
+            zlib.crc32(np.ascontiguousarray(match, dtype=np.int32).tobytes())
+        ),
+    }
+
+
+def test_workload_goldens(digests):
+    got = {k: v for k, v in digests.items() if not k.startswith("_")}
+    if os.environ.get("MOSAIC_UPDATE_GOLDENS") or not GOLDEN.exists():
+        GOLDEN.write_text(json.dumps(got, indent=1))
+        if not os.environ.get("MOSAIC_UPDATE_GOLDENS"):
+            pytest.skip("golden created; rerun to compare")
+    want = json.loads(GOLDEN.read_text())
+    assert got == want
+
+
+def test_area_conservation_bound(digests):
+    """Chips must tile each zone to float tolerance regardless of goldens."""
+    assert digests["_rel_err"] < 1e-6
