@@ -264,3 +264,21 @@ def grid_format_cellid(cells, index: IndexSystem | None = None) -> list[str]:
 
 def grid_parse_cellid(strs, index: IndexSystem | None = None) -> np.ndarray:
     return _index(index).parse(list(strs))
+
+
+# ------------------------------------------------------- legacy v0.2 aliases
+# The reference keeps its pre-rename function names registered as aliases
+# (`functions/MosaicContext.scala:419-424`, `grid_tessellateaslong` :304-308);
+# a user migrating old notebooks finds the same names here.
+polyfill = grid_polyfill
+mosaicfill = grid_tessellate
+mosaic_explode = grid_tessellateexplode
+grid_tessellateaslong = grid_tessellate  # cell ids are int64 already
+point_index_geom = grid_pointascellid
+point_index_lonlat = grid_longlatascellid
+index_geometry = grid_boundaryaswkb
+
+__all__ += [
+    "polyfill", "mosaicfill", "mosaic_explode", "grid_tessellateaslong",
+    "point_index_geom", "point_index_lonlat", "index_geometry",
+]

@@ -61,14 +61,16 @@ def edge_planes(polys: DeviceGeometry, g_pad: int = 128, e_pad: int = 64):
     any point's scanline. ``e_pad`` should be a multiple of pip_zone's
     ``tile_e`` and ``g_pad`` a multiple of its ``tile_g`` (defaults align).
     """
-    from ..core.geometry.device import edges as _edges
-
-    v = polys.verts  # (G,R,V,2)
+    # host-side edge extraction (same layout contract as
+    # core.geometry.device.edges): one verts-sized device-to-host copy,
+    # then pure numpy — no device dispatch during an index build
+    v = np.asarray(polys.verts)  # (G,R,V,2)
     G, R, V = v.shape[0], v.shape[1], v.shape[2]
-    a4, b4, poly_mask, _, _ = _edges(polys)
-    a = np.asarray(a4).reshape(G, R * (V - 1), 2)
-    b = np.asarray(b4).reshape(G, R * (V - 1), 2)
-    mask = np.asarray(poly_mask).reshape(G, R * (V - 1))
+    ring_len = np.asarray(polys.ring_len)
+    a = v[:, :, :-1, :].reshape(G, R * (V - 1), 2)
+    b = v[:, :, 1:, :].reshape(G, R * (V - 1), 2)
+    idx = np.arange(V - 1, dtype=np.int32)[None, None, :]
+    mask = (idx < ring_len[:, :, None]).reshape(G, R * (V - 1))
     # compact each zone's real edges to the front and trim E to the max
     # real count: the (R, V) padded flattening interleaves pad slots, and
     # the kernel's cost is linear in E — on the NYC zones this cuts the

@@ -248,6 +248,23 @@ def _grid_specs(e, grid_name):
         "grid_boundaryaswkb": lambda: [
             len(b) for b in F.grid_boundaryaswkb(c8[:2], index=idx)
         ],
+        # legacy v0.2 aliases (MosaicContext.scala:419-424): must resolve
+        # to the same callables and results as their grid_ targets
+        "polyfill": lambda: [len(c) for c in F.polyfill(g, res, index=idx)],
+        "mosaicfill": lambda: F.mosaicfill(g, res, index=idx),
+        "mosaic_explode": lambda: F.mosaic_explode(g, res, index=idx),
+        "grid_tessellateaslong": lambda: F.grid_tessellateaslong(
+            g, res, index=idx
+        ),
+        "point_index_geom": lambda: F.point_index_geom(
+            F.st_point(pts[:, 0], pts[:, 1]), res, index=idx
+        ),
+        "point_index_lonlat": lambda: F.point_index_lonlat(
+            pts[:, 0], pts[:, 1], res, index=idx
+        ),
+        "index_geometry": lambda: [
+            len(b) for b in F.index_geometry(c8[:2], index=idx)
+        ],
         "grid_cellkring": lambda: F.grid_cellkring(c8, 2, index=idx),
         "grid_cellkloop": lambda: F.grid_cellkloop(c8, 2, index=idx),
         "grid_cellkringexplode": lambda: F.grid_cellkringexplode(c8[:3], 1, index=idx),
