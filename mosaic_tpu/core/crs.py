@@ -353,10 +353,27 @@ def albers_inverse(p, en, xp=np):
 
 
 def laea_forward(p, lonlat, xp=np):
-    """Lambert azimuthal equal-area, oblique ellipsoidal (Snyder 24)."""
+    """Lambert azimuthal equal-area, oblique ellipsoidal (Snyder 24).
+
+    Polar aspects (|lat0| = 90, e.g. North Pole LAEA / EASE-Grid 2.0) use
+    the dedicated Snyder 24-23/24-25 forms — the oblique D constant is
+    0/0 at the poles."""
     a, e, lat0, lon0, fe, fn = p
     lon, lat = lonlat[..., 0], lonlat[..., 1]
     qp = _q_fn(np.asarray(np.pi / 2), e, np)
+    if abs(abs(lat0) - np.pi / 2) < 1e-8:
+        north = lat0 > 0
+        q = _q_fn(lat, e, xp)
+        # snap the exact poles: float asymmetry of q(-pi/2) vs -q(pi/2)
+        # is ~1e-15, which the sqrt amplifies to ~0.2 m
+        q = xp.where(
+            xp.abs(lat) >= np.pi / 2 - 1e-12, xp.sign(lat) * qp, q
+        )
+        dl = lon - lon0
+        rho = a * xp.sqrt(xp.maximum(qp - q if north else qp + q, 0.0))
+        x = fe + rho * xp.sin(dl)
+        y = fn + (-rho if north else rho) * xp.cos(dl)
+        return xp.stack([x, y], axis=-1)
     q0 = _q_fn(np.asarray(lat0), e, np)
     b0 = np.arcsin(q0 / qp)
     Rq = a * np.sqrt(qp / 2)
@@ -378,6 +395,20 @@ def laea_forward(p, lonlat, xp=np):
 def laea_inverse(p, en, xp=np):
     a, e, lat0, lon0, fe, fn = p
     qp = _q_fn(np.asarray(np.pi / 2), e, np)
+    if abs(abs(lat0) - np.pi / 2) < 1e-8:
+        north = lat0 > 0
+        x = en[..., 0] - fe
+        y = en[..., 1] - fn
+        rho = xp.sqrt(x * x + y * y)
+        q = qp - (rho / a) ** 2 if north else (rho / a) ** 2 - qp
+        lat = _phi_from_q(q, e, xp)
+        lon = lon0 + (
+            xp.arctan2(x, -y) if north else xp.arctan2(x, y)
+        )
+        at_center = rho < 1e-9
+        lat = xp.where(at_center, lat0, lat)
+        lon = xp.where(at_center, lon0, lon)
+        return xp.stack([lon, lat], axis=-1)
     q0 = _q_fn(np.asarray(lat0), e, np)
     b0 = np.arcsin(q0 / qp)
     Rq = a * np.sqrt(qp / 2)
@@ -516,6 +547,95 @@ _NAMED: dict[int, tuple[str, tuple, tuple[float, float, float, float]]] = {
         (WGS84_A, _WGS84_E, True, None, 0.994, _R(0.0), 2000000.0, 2000000.0),
         (-180.0, -90.0, 180.0, -60.0),
     ),
+    # ETRS89 / LCC Europe
+    3034: (
+        "lcc2sp",
+        _conic(GRS80_A, _GRS80_E, 52.0, 10.0, 35.0, 65.0, 4000000.0, 2800000.0),
+        (-16.1, 32.88, 40.18, 84.73),
+    ),
+    # NAD83 / Statistics Canada Lambert
+    3347: (
+        "lcc2sp",
+        _conic(
+            GRS80_A, _GRS80_E, 63.390675, -91.8666666667, 49.0, 77.0,
+            6200000.0, 3000000.0,
+        ),
+        (-141.01, 40.04, -47.74, 86.46),
+    ),
+    # NAD83 / Canada Atlas Lambert
+    3978: (
+        "lcc2sp",
+        _conic(GRS80_A, _GRS80_E, 49.0, -95.0, 49.0, 77.0, 0.0, 0.0),
+        (-141.01, 40.04, -47.74, 86.46),
+    ),
+    # GDA94 / Geoscience Australia Lambert
+    3112: (
+        "lcc2sp",
+        _conic(GRS80_A, _GRS80_E, 0.0, 134.0, -18.0, -36.0, 0.0, 0.0),
+        (112.85, -43.7, 153.69, -9.86),
+    ),
+    # NAD83(2011) / Conus Albers (same projection as 5070)
+    6350: (
+        "albers",
+        _conic(GRS80_A, _GRS80_E, 23.0, -96.0, 29.5, 45.5, 0.0, 0.0),
+        (-124.79, 24.41, -66.91, 49.38),
+    ),
+    # ESRI USA Contiguous Albers Equal Area Conic
+    102003: (
+        "albers",
+        _conic(GRS80_A, _GRS80_E, 37.5, -96.0, 29.5, 45.5, 0.0, 0.0),
+        (-124.79, 24.41, -66.91, 49.38),
+    ),
+    # NAD83 / California Albers
+    3310: (
+        "albers",
+        _conic(GRS80_A, _GRS80_E, 0.0, -120.0, 34.0, 40.5, 0.0, -4000000.0),
+        (-124.45, 32.53, -114.12, 42.01),
+    ),
+    # WGS 84 / North Pole LAEA (Canada / Atlantic / Europe / Russia)
+    3573: (
+        "laea",
+        (WGS84_A, _WGS84_E, _R(90.0), _R(-100.0), 0.0, 0.0),
+        (-180.0, 45.0, 180.0, 90.0),
+    ),
+    3574: (
+        "laea",
+        (WGS84_A, _WGS84_E, _R(90.0), _R(-40.0), 0.0, 0.0),
+        (-180.0, 45.0, 180.0, 90.0),
+    ),
+    3575: (
+        "laea",
+        (WGS84_A, _WGS84_E, _R(90.0), _R(10.0), 0.0, 0.0),
+        (-180.0, 45.0, 180.0, 90.0),
+    ),
+    3576: (
+        "laea",
+        (WGS84_A, _WGS84_E, _R(90.0), _R(90.0), 0.0, 0.0),
+        (-180.0, 45.0, 180.0, 90.0),
+    ),
+    # WGS 84 / NSIDC EASE-Grid 2.0 North and South
+    6931: (
+        "laea",
+        (WGS84_A, _WGS84_E, _R(90.0), _R(0.0), 0.0, 0.0),
+        (-180.0, 0.0, 180.0, 90.0),
+    ),
+    6932: (
+        "laea",
+        (WGS84_A, _WGS84_E, _R(-90.0), _R(0.0), 0.0, 0.0),
+        (-180.0, -90.0, 180.0, 0.0),
+    ),
+    # WGS 84 / Arctic Polar Stereographic
+    3995: (
+        "stere_polar",
+        (WGS84_A, _WGS84_E, False, _R(71.0), None, _R(0.0), 0.0, 0.0),
+        (-180.0, 60.0, 180.0, 90.0),
+    ),
+    # NSIDC Sea Ice Polar Stereographic South
+    3976: (
+        "stere_polar",
+        (WGS84_A, _WGS84_E, True, _R(-70.0), None, _R(0.0), 0.0, 0.0),
+        (-180.0, -90.0, 180.0, -60.0),
+    ),
 }
 
 # stereographic params order note: (a, e, south, lat_ts, k0, lon0, fe, fn)
@@ -535,6 +655,32 @@ _NAMED_TM: dict[int, tuple[TMParams, tuple[float, float, float, float]]] = {
             n0=10000000.0,
         ),
         (166.0, -47.4, 178.63, -34.0),
+    ),
+    # ETRS89 / Poland CS92
+    2180: (
+        TMParams(
+            a=GRS80_A,
+            b=GRS80_A * (1 - GRS80_F),
+            f0=0.9993,
+            lat0=0.0,
+            lon0=_R(19.0),
+            e0=500000.0,
+            n0=-5300000.0,
+        ),
+        (14.14, 49.0, 24.15, 55.03),
+    ),
+    # Korea 2000 / Central Belt 2010
+    5186: (
+        TMParams(
+            a=GRS80_A,
+            b=GRS80_A * (1 - GRS80_F),
+            f0=1.0,
+            lat0=_R(38.0),
+            lon0=_R(127.5),
+            e0=200000.0,
+            n0=600000.0,
+        ),
+        (124.5, 33.0, 132.0, 43.0),
     ),
 }
 

@@ -112,7 +112,7 @@ def pack_to_device(
     )
 
 
-def edges(geoms: DeviceGeometry):
+def edges(geoms, xp=jnp):
     """Shared edge extraction: returns (a, b, poly_mask, line_mask, type_mask).
 
     a, b: (G, R, V-1, 2) edge endpoints. ``poly_mask`` treats rings as closed
@@ -121,19 +121,21 @@ def edges(geoms: DeviceGeometry):
     the right one per geometry's type (points contribute no edges).
 
     Single source of truth for measures, predicates and the Pallas kernel
-    edge-plane packing — keep them in sync by construction.
+    edge-plane packing — keep them in sync by construction. ``geoms`` is a
+    DeviceGeometry or anything with verts/ring_len/geom_type arrays of the
+    same layout; pass ``xp=np`` to run on host copies (index builds).
     """
     v = geoms.verts
     a = v[:, :, :-1, :]
     b = v[:, :, 1:, :]
-    idx = jnp.arange(v.shape[2] - 1, dtype=jnp.int32)[None, None, :]
+    idx = xp.arange(v.shape[2] - 1, dtype=xp.int32)[None, None, :]
     poly_mask = idx < geoms.ring_len[:, :, None]
     line_mask = idx < (geoms.ring_len[:, :, None] - 1)
     gt = geoms.geom_type
-    type_mask = jnp.where(
+    type_mask = xp.where(
         is_polygonal(gt)[:, None, None],
         poly_mask,
-        jnp.where(is_linear(gt)[:, None, None], line_mask, False),
+        xp.where(is_linear(gt)[:, None, None], line_mask, False),
     )
     return a, b, poly_mask, line_mask, type_mask
 

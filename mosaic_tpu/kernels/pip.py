@@ -61,16 +61,24 @@ def edge_planes(polys: DeviceGeometry, g_pad: int = 128, e_pad: int = 64):
     any point's scanline. ``e_pad`` should be a multiple of pip_zone's
     ``tile_e`` and ``g_pad`` a multiple of its ``tile_g`` (defaults align).
     """
-    # host-side edge extraction (same layout contract as
-    # core.geometry.device.edges): one verts-sized device-to-host copy,
-    # then pure numpy — no device dispatch during an index build
-    v = np.asarray(polys.verts)  # (G,R,V,2)
-    G, R, V = v.shape[0], v.shape[1], v.shape[2]
-    ring_len = np.asarray(polys.ring_len)
-    a = v[:, :, :-1, :].reshape(G, R * (V - 1), 2)
-    b = v[:, :, 1:, :].reshape(G, R * (V - 1), 2)
-    idx = np.arange(V - 1, dtype=np.int32)[None, None, :]
-    mask = (idx < ring_len[:, :, None]).reshape(G, R * (V - 1))
+    # host-side edge extraction through the shared contract
+    # (core.geometry.device.edges with xp=np): one verts-sized
+    # device-to-host copy, then pure numpy — no device dispatch during an
+    # index build
+    from types import SimpleNamespace
+
+    from ..core.geometry.device import edges as _edges
+
+    host = SimpleNamespace(
+        verts=np.asarray(polys.verts),
+        ring_len=np.asarray(polys.ring_len),
+        geom_type=np.asarray(polys.geom_type),
+    )
+    G, R, V = host.verts.shape[0], host.verts.shape[1], host.verts.shape[2]
+    a4, b4, poly_mask, _, _ = _edges(host, xp=np)
+    a = a4.reshape(G, R * (V - 1), 2)
+    b = b4.reshape(G, R * (V - 1), 2)
+    mask = poly_mask.reshape(G, R * (V - 1))
     # compact each zone's real edges to the front and trim E to the max
     # real count: the (R, V) padded flattening interleaves pad slots, and
     # the kernel's cost is linear in E — on the NYC zones this cuts the

@@ -145,6 +145,14 @@ class TestProjectionFamilies:
         (3031, (0.0, -90.0), (0.0, 0.0)),             # polar stereo S
         (32661, (0.0, 90.0), (2000000.0, 2000000.0)), # UPS North
         (2193, (173.0, 0.0), (1600000.0, 10000000.0)),# NZTM2000
+        (3034, (10.0, 52.0), (4000000.0, 2800000.0)), # LCC Europe
+        (3978, (-95.0, 49.0), (0.0, 0.0)),            # Canada Atlas Lambert
+        (3310, (-120.0, 0.0), (0.0, -4000000.0)),     # California Albers
+        (6931, (0.0, 90.0), (0.0, 0.0)),              # EASE-Grid 2.0 North
+        (6932, (0.0, -90.0), (0.0, 0.0)),             # EASE-Grid 2.0 South
+        (3995, (0.0, 90.0), (0.0, 0.0)),              # Arctic Polar Stereo
+        (2180, (19.0, 0.0), (500000.0, -5300000.0)),  # Poland CS92
+        (5186, (127.5, 38.0), (200000.0, 600000.0)),  # Korea Central Belt
     ]
 
     @pytest.mark.parametrize("srid,ll,en", ANCHORS)
@@ -154,7 +162,9 @@ class TestProjectionFamilies:
 
     @pytest.mark.parametrize(
         "srid",
-        [2154, 5070, 3035, 3577, 3413, 3031, 32661, 32761, 2193, 25832, 26917],
+        [2154, 5070, 3035, 3577, 3413, 3031, 32661, 32761, 2193, 25832,
+         26917, 3034, 3347, 3978, 3112, 6350, 102003, 3310, 3573, 3574,
+         3575, 3576, 6931, 6932, 3995, 3976, 2180, 5186],
     )
     def test_roundtrip_under_1e6_deg(self, srid):
         rng = np.random.default_rng(srid)
