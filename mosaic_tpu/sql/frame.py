@@ -29,6 +29,7 @@ class MosaicFrame:
     columns: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
     resolution: "int | None" = None
     chips: "ChipTable | None" = None  # set by set_index_resolution
+    chips_index: "str | None" = None  # index-system name the chips used
 
     # ------------------------------------------------------------ builders
     @classmethod
@@ -69,7 +70,16 @@ class MosaicFrame:
             self.geometry, resolution, keep_core_geoms=keep_core_geoms,
             index=index,
         )
-        return dataclasses.replace(self, resolution=resolution, chips=chips)
+        if index is None:
+            from ..context import current_context
+
+            index = current_context().index_system
+        return dataclasses.replace(
+            self,
+            resolution=resolution,
+            chips=chips,
+            chips_index=getattr(index, "name", str(index)),
+        )
 
     # --------------------------------------------------------------- joins
     def point_in_polygon_join(
@@ -108,6 +118,45 @@ class MosaicFrame:
                 col = np.where(ok, col.astype(object), None)
             out[f"polygon_{k}"] = col
         return out
+
+    def intersects_join(
+        self,
+        other: "MosaicFrame",
+        index=None,
+        resolution: "int | None" = None,
+    ) -> np.ndarray:
+        """Polygon-polygon ST_Intersects overlay join (reference: the BNG
+        overlay workload). Returns distinct (this_row, other_row) pairs.
+        Prebuilt chip tables (`set_index_resolution`) on either frame are
+        reused."""
+        from ..sql.overlay import intersects_join as _ov
+
+        if index is None:
+            from ..context import current_context
+
+            index = current_context().index_system
+        res = resolution if resolution is not None else self.resolution
+        if res is None:
+            res = self.get_optimal_resolution(index)
+        # reuse prebuilt chips only when both resolution AND index system
+        # match — joining BNG cell ids against H3 ids would silently fail
+        iname = getattr(index, "name", str(index))
+
+        def _reusable(frame):
+            return (
+                frame.chips
+                if frame.resolution == res and frame.chips_index == iname
+                else None
+            )
+
+        return _ov(
+            self.geometry,
+            other.geometry,
+            index,
+            res,
+            left_chips=_reusable(self),
+            right_chips=_reusable(other),
+        )
 
     # ------------------------------------------------------------- display
     def prettified(self, n: int = 10) -> str:
