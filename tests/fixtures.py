@@ -40,3 +40,19 @@ def random_points(n, bbox=(-74.3, 40.4, -73.6, 41.0), seed=0):
     x = rng.uniform(bbox[0], bbox[2], n)
     y = rng.uniform(bbox[1], bbox[3], n)
     return np.column_stack([x, y])
+
+
+def oracle_pairs(left, right):
+    """Dense O(L*R) f64-oracle st_intersects pair matrix (tests)."""
+    import numpy as np
+
+    from mosaic_tpu.functions import geometry as F
+
+    pairs = []
+    for i in range(len(left)):
+        a = left.slice(i, i + 1)
+        for j in range(len(right)):
+            hit = F.st_intersects(a, right.slice(j, j + 1), backend="oracle")
+            if bool(np.asarray(hit)[0]):
+                pairs.append((i, j))
+    return np.asarray(sorted(pairs), np.int64).reshape(-1, 2)

@@ -12,7 +12,6 @@ import pytest
 from mosaic_tpu.core.geometry import wkt
 from mosaic_tpu.core.index.bng import BNGIndexSystem
 from mosaic_tpu.core.index.h3 import H3IndexSystem
-from mosaic_tpu.functions import geometry as F
 from mosaic_tpu.sql.overlay import intersects_join
 
 
@@ -28,17 +27,7 @@ def _squares(n, size, offx, offy, scale=1.0):
     return out
 
 
-def _oracle_pairs(left, right):
-    pairs = []
-    for i in range(len(left)):
-        a = left.slice(i, i + 1)
-        for j in range(len(right)):
-            hit = F.st_intersects(
-                a, right.slice(j, j + 1), backend="oracle"
-            )
-            if bool(np.asarray(hit)[0]):
-                pairs.append((i, j))
-    return np.asarray(sorted(pairs), np.int64).reshape(-1, 2)
+from fixtures import oracle_pairs as _oracle_pairs
 
 
 @pytest.mark.parametrize("grid", ["h3", "bng"])

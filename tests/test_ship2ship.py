@@ -12,7 +12,6 @@ import numpy as np
 from mosaic_tpu.core.geometry import wkt
 from mosaic_tpu.core.index.h3 import H3IndexSystem
 from mosaic_tpu.functions import geometry as F
-from mosaic_tpu.functions._coerce import to_packed
 from mosaic_tpu.sql.overlay import intersects_join
 
 
@@ -36,27 +35,21 @@ def _tracks(n, seed):
 def test_ship2ship_corridor_join():
     tracks_a = _tracks(8, seed=3)
     tracks_b = _tracks(8, seed=9)
-    # ~500 m corridors in degree units
-    buf_a = to_packed(F.st_buffer(tracks_a, 0.005))
-    buf_b = to_packed(F.st_buffer(tracks_b, 0.005))
+    from fixtures import oracle_pairs
+
+    # ~500 m corridors in degree units; packed input keeps st_buffer's
+    # output packed (no WKT round trip)
+    buf_a = F.st_buffer(wkt.from_wkt(tracks_a), 0.005)
+    buf_b = F.st_buffer(wkt.from_wkt(tracks_b), 0.005)
 
     got = intersects_join(buf_a, buf_b, H3IndexSystem(), 7)
-
-    want = []
-    for i in range(len(buf_a)):
-        for j in range(len(buf_b)):
-            hit = F.st_intersects(
-                buf_a.slice(i, i + 1), buf_b.slice(j, j + 1), backend="oracle"
-            )
-            if bool(np.asarray(hit)[0]):
-                want.append((i, j))
-    want = np.asarray(sorted(want), np.int64).reshape(-1, 2)
+    want = oracle_pairs(buf_a, buf_b)
     np.testing.assert_array_equal(got, want)
     assert want.shape[0] > 0  # the region is dense enough to overlap
 
 
 def test_buffered_track_area_positive():
-    buf = to_packed(F.st_buffer(_tracks(3, seed=1), 0.01))
+    buf = F.st_buffer(wkt.from_wkt(_tracks(3, seed=1)), 0.01)
     areas = F.st_area(buf, backend="oracle")
     assert (areas > 0).all()
     # corridor area ~ 2 * r * length (+ caps); sanity-bound it
