@@ -104,15 +104,21 @@ def _probe_platform() -> str:
         "import jax, sys; d = jax.devices(); "
         "sys.exit(0 if d and d[0].platform not in ('cpu',) else 3)"
     )
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout,
-            capture_output=True,
-        )
-        return "tpu" if r.returncode == 0 else "cpu"
-    except (subprocess.TimeoutExpired, OSError):
-        return "cpu"
+    # a hung probe (tunnel hiccup) gets one retry after a pause — a CPU
+    # fallback records a misleading number for the whole round; a clean
+    # CPU verdict (rc != 0) is final. Worst case 2 * timeout + 20s.
+    for attempt in range(2):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=timeout,
+                capture_output=True,
+            )
+            return "tpu" if r.returncode == 0 else "cpu"
+        except (subprocess.TimeoutExpired, OSError):
+            if attempt == 0:
+                time.sleep(20)
+    return "cpu"
 
 
 _CACHE_VERSION = 4  # bump when ChipIndex layout changes
@@ -150,10 +156,12 @@ def _load_or_build_index(zones, zones_src: str, h3):
                 border=border,
                 **{n: jnp.asarray(z[n]) for n in index_names},
             )
-            return ix, True
+            return ix, True, None
         except Exception:
             pass  # stale/corrupt cache: rebuild
+    t0 = time.perf_counter()
     table = tessellate(zones, h3, RES, keep_core_geoms=False)
+    tess_only_s = time.perf_counter() - t0
     index = build_chip_index(table)
     try:
         os.makedirs(os.path.dirname(cache), exist_ok=True)
@@ -165,7 +173,7 @@ def _load_or_build_index(zones, zones_src: str, h3):
         )
     except OSError:
         pass
-    return index, False
+    return index, False, tess_only_s
 
 
 def _load_zones():
@@ -223,11 +231,21 @@ def main():
             float(np.nanmax(b[:, 3])),
         )
         t0 = time.perf_counter()
-        index, cache_hit = _load_or_build_index(zones, zones_src, h3)
+        index, cache_hit, tess_only_s = _load_or_build_index(
+            zones, zones_src, h3
+        )
         # on a hit this is npz-load time, NOT tessellation speed — the
         # flag keeps cross-round comparisons honest
-        detail["tessellate_s"] = round(time.perf_counter() - t0, 2)
+        tess_s = time.perf_counter() - t0
+        detail["tessellate_s"] = round(tess_s, 2)
         detail["tessellate_cache_hit"] = cache_hit
+        if tess_only_s:
+            # BASELINE's secondary metric: H3 tessellate chips/sec —
+            # timed around tessellate() alone (not index build or the
+            # cache write), and only when actually computed
+            detail["tessellate_chips_per_sec"] = round(
+                int(index.chip_geom.shape[0]) / tess_only_s, 1
+            )
         detail.update(
             n_zones=len(zones),
             n_chips=int(index.chip_geom.shape[0]),

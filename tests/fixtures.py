@@ -44,8 +44,6 @@ def random_points(n, bbox=(-74.3, 40.4, -73.6, 41.0), seed=0):
 
 def oracle_pairs(left, right):
     """Dense O(L*R) f64-oracle st_intersects pair matrix (tests)."""
-    import numpy as np
-
     from mosaic_tpu.functions import geometry as F
 
     pairs = []

@@ -14,6 +14,8 @@ from mosaic_tpu.core.index.bng import BNGIndexSystem
 from mosaic_tpu.core.index.h3 import H3IndexSystem
 from mosaic_tpu.sql.overlay import intersects_join
 
+from fixtures import oracle_pairs as _oracle_pairs
+
 
 def _squares(n, size, offx, offy, scale=1.0):
     out = []
@@ -27,7 +29,6 @@ def _squares(n, size, offx, offy, scale=1.0):
     return out
 
 
-from fixtures import oracle_pairs as _oracle_pairs
 
 
 @pytest.mark.parametrize("grid", ["h3", "bng"])

@@ -14,6 +14,8 @@ from mosaic_tpu.core.index.h3 import H3IndexSystem
 from mosaic_tpu.functions import geometry as F
 from mosaic_tpu.sql.overlay import intersects_join
 
+from fixtures import oracle_pairs
+
 
 def _tracks(n, seed):
     """n jittered great-circle-ish linestrings around the North Sea."""
@@ -35,8 +37,6 @@ def _tracks(n, seed):
 def test_ship2ship_corridor_join():
     tracks_a = _tracks(8, seed=3)
     tracks_b = _tracks(8, seed=9)
-    from fixtures import oracle_pairs
-
     # ~500 m corridors in degree units; packed input keeps st_buffer's
     # output packed (no WKT round trip)
     buf_a = F.st_buffer(wkt.from_wkt(tracks_a), 0.005)
