@@ -106,7 +106,8 @@ def _probe_platform() -> str:
     )
     # a hung probe (tunnel hiccup) gets one retry after a pause — a CPU
     # fallback records a misleading number for the whole round; a clean
-    # CPU verdict (rc != 0) is final. Worst case 2 * timeout + 20s.
+    # CPU verdict (rc != 0) or a deterministic spawn failure is final.
+    # Worst case 2 * timeout + 20s.
     for attempt in range(2):
         try:
             r = subprocess.run(
@@ -115,9 +116,11 @@ def _probe_platform() -> str:
                 capture_output=True,
             )
             return "tpu" if r.returncode == 0 else "cpu"
-        except (subprocess.TimeoutExpired, OSError):
+        except subprocess.TimeoutExpired:
             if attempt == 0:
                 time.sleep(20)
+        except OSError:
+            break
     return "cpu"
 
 
