@@ -1,0 +1,27 @@
+"""docs/QUICKSTART.md is executable documentation: every fenced python
+block runs here, in order, in one shared namespace — the doc cannot
+drift from the library. (Reference analog: the QuickstartNotebook is the
+reference's living example of the same workflow.)"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+DOC = Path(__file__).parent.parent / "docs" / "QUICKSTART.md"
+
+
+def _blocks():
+    text = DOC.read_text()
+    return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
+
+
+def test_quickstart_blocks_execute():
+    blocks = _blocks()
+    assert len(blocks) >= 6
+    ns: dict = {}
+    for i, src in enumerate(blocks):
+        try:
+            exec(compile(src, f"{DOC.name}[block {i}]", "exec"), ns)
+        except Exception as e:  # pragma: no cover - failure reporting
+            pytest.fail(f"QUICKSTART block {i} failed: {e}\n---\n{src}")
