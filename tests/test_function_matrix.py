@@ -134,13 +134,16 @@ BACKEND_DUAL = [
 
 @pytest.mark.parametrize("name", BACKEND_DUAL)
 def test_backend_parity(name, env):
-    """device (jnp) and oracle (host) backends must agree (the analog of
-    the reference's codegen-vs-interpreted equality)."""
+    """device (jnp), oracle (host) and the independent C++ second engine
+    must agree (the analog of the reference's codegen-vs-interpreted AND
+    JTS-vs-ESRI double equality)."""
     g = env["H3"]["geom"]
     fn = getattr(F, name)
     dev = np.asarray(fn(g, backend="device"), dtype=np.float64)
     orc = np.asarray(fn(g, backend="oracle"), dtype=np.float64)
+    nat = np.asarray(fn(g, backend="native"), dtype=np.float64)
     np.testing.assert_allclose(dev, orc, rtol=2e-5, atol=1e-7)
+    np.testing.assert_allclose(nat, orc, rtol=1e-11, atol=1e-12)
 
 
 def _geom_specs(e):
