@@ -30,7 +30,8 @@ from ..core.types import GeometryBuilder, GeometryType, PackedGeometry
 from ._coerce import coerce, like_input, to_packed
 
 __all__ = [
-    "st_area", "st_length", "st_perimeter", "st_centroid", "st_envelope",
+    "st_area", "st_length", "st_perimeter", "st_centroid", "st_centroid2D",
+    "st_centroid2d", "st_centroid3D", "st_centroid3d", "st_envelope",
     "st_buffer", "st_bufferloop", "st_convexhull", "st_simplify",
     "st_intersection", "st_union", "st_difference", "st_symdifference",
     "st_unaryunion", "st_dump", "flatten_polygons", "st_contains",
@@ -105,6 +106,42 @@ def st_centroid(geom, backend: str | None = None):
     for g in range(len(col)):
         b.add_geometry(GeometryType.POINT, [[cxy[g : g + 1]]], int(col.srid[g]))
     return like_input(b.build(), fmt)
+
+
+def st_centroid2D(geom, backend: str | None = None) -> np.ndarray:
+    """(N, 2) centroid x/y struct (reference: ST_Centroid2D —
+    `docs/source/api/spatial-functions.rst:244-250`)."""
+    col = to_packed(geom)
+    b = _resolve_backend(backend)
+    if b == "oracle":
+        return _oracle.centroid(col)
+    if b == "native":
+        return _second.centroid(col)
+    dg = _dev(col)
+    return np.asarray(_meas.centroid(dg), dtype=np.float64) + _shift(dg)
+
+
+def st_centroid2d(geom, backend: str | None = None) -> np.ndarray:
+    return st_centroid2D(geom, backend)
+
+
+def st_centroid3D(geom, backend: str | None = None) -> np.ndarray:
+    """(N, 3) centroid x/y/z; z is the mean vertex z (NaN when the row
+    has no Z) — the JTS 3D-centroid contract ST_Centroid3D exposes."""
+    col = to_packed(geom)
+    xy = st_centroid2D(col, backend)
+    z = np.full(len(col), np.nan)
+    if col.z is not None:
+        for g in range(len(col)):
+            if col.has_z(g):
+                zz = col.z[col.geom_vertex_slice(g)]
+                if zz.size:
+                    z[g] = float(zz.mean())
+    return np.concatenate([xy, z[:, None]], axis=1)
+
+
+def st_centroid3d(geom, backend: str | None = None) -> np.ndarray:
+    return st_centroid3D(geom, backend)
 
 
 def _bounds(col: PackedGeometry, backend: str | None) -> np.ndarray:

@@ -156,6 +156,10 @@ def _geom_specs(e):
         "st_length": lambda: F.st_length(g),
         "st_perimeter": lambda: F.st_perimeter(g),
         "st_centroid": lambda: F.st_centroid(g),
+        "st_centroid2D": lambda: F.st_centroid2D(g),
+        "st_centroid2d": lambda: F.st_centroid2d(g),
+        "st_centroid3D": lambda: F.st_centroid3D(g),
+        "st_centroid3d": lambda: F.st_centroid3d(g),
         "st_envelope": lambda: F.st_envelope(g),
         "st_buffer": lambda: F.st_area(F.st_buffer(g.slice(0, 2), 0.005)),
         "st_bufferloop": lambda: F.st_area(F.st_bufferloop(g.slice(0, 2), 0.002, 0.005)),
