@@ -91,27 +91,9 @@ def st_length(geom, backend: str | None = None) -> np.ndarray:
 st_perimeter = st_length
 
 
-def st_centroid(geom, backend: str | None = None):
-    """Centroid as a POINT column, serialized like the input."""
-    col, fmt = coerce(geom)
-    b = _resolve_backend(backend)
-    if b == "oracle":
-        cxy = _oracle.centroid(col)
-    elif b == "native":
-        cxy = _second.centroid(col)
-    else:
-        dg = _dev(col)
-        cxy = np.asarray(_meas.centroid(dg), dtype=np.float64) + _shift(dg)
-    b = GeometryBuilder()
-    for g in range(len(col)):
-        b.add_geometry(GeometryType.POINT, [[cxy[g : g + 1]]], int(col.srid[g]))
-    return like_input(b.build(), fmt)
-
-
-def st_centroid2D(geom, backend: str | None = None) -> np.ndarray:
-    """(N, 2) centroid x/y struct (reference: ST_Centroid2D —
-    `docs/source/api/spatial-functions.rst:244-250`)."""
-    col = to_packed(geom)
+def _centroid_xy(col: PackedGeometry, backend: str | None) -> np.ndarray:
+    """(N, 2) centroid coordinates — the one copy of the three-engine
+    dispatch every centroid-flavoured function routes through."""
     b = _resolve_backend(backend)
     if b == "oracle":
         return _oracle.centroid(col)
@@ -121,15 +103,30 @@ def st_centroid2D(geom, backend: str | None = None) -> np.ndarray:
     return np.asarray(_meas.centroid(dg), dtype=np.float64) + _shift(dg)
 
 
-def st_centroid2d(geom, backend: str | None = None) -> np.ndarray:
-    return st_centroid2D(geom, backend)
+def st_centroid(geom, backend: str | None = None):
+    """Centroid as a POINT column, serialized like the input."""
+    col, fmt = coerce(geom)
+    cxy = _centroid_xy(col, backend)
+    b = GeometryBuilder()
+    for g in range(len(col)):
+        b.add_geometry(GeometryType.POINT, [[cxy[g : g + 1]]], int(col.srid[g]))
+    return like_input(b.build(), fmt)
+
+
+# the reference registers st_centroid2D as an exact alias of st_centroid
+# (MosaicContext.scala:784): geometry in, POINT geometry out
+st_centroid2D = st_centroid
+st_centroid2d = st_centroid
 
 
 def st_centroid3D(geom, backend: str | None = None) -> np.ndarray:
-    """(N, 3) centroid x/y/z; z is the mean vertex z (NaN when the row
-    has no Z) — the JTS 3D-centroid contract ST_Centroid3D exposes."""
+    """(N, 3) centroid x/y/z struct (reference docs
+    `spatial-functions.rst:297-303`: StructType[x, y, z] — documented but
+    never registered in the reference's MosaicContext, so the z semantic
+    here is this repo's: the mean vertex z per row, NaN without Z; x/y
+    are the area-weighted centroid, matching st_centroid)."""
     col = to_packed(geom)
-    xy = st_centroid2D(col, backend)
+    xy = _centroid_xy(col, backend)
     z = np.full(len(col), np.nan)
     if col.z is not None:
         for g in range(len(col)):

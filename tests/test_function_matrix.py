@@ -128,7 +128,7 @@ def env():
 # ---------------------------------------------------------------- geometry
 BACKEND_DUAL = [
     "st_area", "st_length", "st_perimeter",
-    "st_xmin", "st_xmax", "st_ymin", "st_ymax",
+    "st_xmin", "st_xmax", "st_ymin", "st_ymax", "st_centroid3D",
 ]
 
 
@@ -158,7 +158,13 @@ def _geom_specs(e):
         "st_centroid": lambda: F.st_centroid(g),
         "st_centroid2D": lambda: F.st_centroid2D(g),
         "st_centroid2d": lambda: F.st_centroid2d(g),
-        "st_centroid3D": lambda: F.st_centroid3D(g),
+        # Z-bearing fixture: the NYC shapes are 2D, which would leave the
+        # z column all-NaN and invisible to the nansum digest
+        "st_centroid3D": lambda: F.st_centroid3D(
+            W.from_wkt(
+                ["POINT Z (1 2 3)", "LINESTRING Z (0 0 1, 2 0 5)"]
+            )
+        ),
         "st_centroid3d": lambda: F.st_centroid3d(g),
         "st_envelope": lambda: F.st_envelope(g),
         "st_buffer": lambda: F.st_area(F.st_buffer(g.slice(0, 2), 0.005)),
