@@ -153,6 +153,10 @@ def _build_hash(cells: np.ndarray, max_bucket: int = 8):
     bits = max(4, int(np.ceil(np.log2(max(4 * U, 16)))))
     bits_cap = bits + 6  # bound table growth (and host memory) at 64x
     rng = np.random.default_rng(0xC0FFEE)
+    # NOTE: do not chase smaller B by growing T — measured on v5e, gather
+    # cost is dominated by table footprint (a 262k-row table probes ~8x
+    # slower per element than an 8k-row one), so T ~= 4U with B ~= 3 beats
+    # a larger table with B = 2
     for attempt in range(32):
         mult = np.uint64(rng.integers(0, 2**64, dtype=np.uint64) | np.uint64(1))
         keys = (cells.astype(np.uint64) * mult) >> np.uint64(64 - bits)

@@ -1,0 +1,17 @@
+"""docs/API.md must match the live registry (regenerate on drift)."""
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+def test_api_doc_is_current():
+    import generate_api_docs
+
+    want = generate_api_docs.generate()
+    got = (REPO / "docs" / "API.md").read_text()
+    assert got == want, (
+        "docs/API.md is stale - run: python tools/generate_api_docs.py"
+    )
