@@ -231,7 +231,7 @@ def test_r_bindings_generated_and_complete():
     )
     assert os.path.exists(path), "run tools/generate_r_bindings.py"
     src = open(path).read()
-    exported = set(re.findall(r"^([a-z_0-9]+) <- function", src, re.M))
+    exported = set(re.findall(r"^([A-Za-z_0-9]+) <- function", src, re.M))
     registered = set(mosaic_tpu.MosaicContext.build("H3").register())
     missing = registered - exported
     assert not missing, f"R bindings missing: {sorted(missing)}"
