@@ -261,22 +261,65 @@ def _classify_cells_batch(
     crossing = np.zeros(K, dtype=bool)
     M = gverts.shape[0]
     E = ga.shape[0]
-    # chunk over cells so the (K, L, M) / (E, K*L) intermediates stay bounded
+    # geometry-edge bboxes once, for the per-chunk locality prefilter
+    if E:
+        elo = np.minimum(ga, gb)
+        ehi = np.maximum(ga, gb)
+    # per-cell bboxes (padding masked out)
+    big = np.where(jmask[:, :, None], cells, np.inf)
+    small = np.where(jmask[:, :, None], cells, -np.inf)
+    cell_lo = big.min(axis=1)  # (K, 2)
+    cell_hi = small.max(axis=1)
+    # chunk over cells so the (K, L, M) / (E, K*L) intermediates stay
+    # bounded. For vertex-heavy geometries, additionally cap the chunk
+    # small so its combined bbox keeps spatial locality (cell ids arrive
+    # roughly spatially sorted) and the prefilter can reject most edges;
+    # for small geometries the per-chunk overhead outweighs any rejection,
+    # so keep one big vectorized pass (measured: 10-vertex zones were
+    # 2.5x slower under an unconditional cap).
     chunk = max(1, int(2e7 // max(L * max(M, E), 1)))
+    if max(M, E) >= 256:
+        chunk = min(chunk, 8)
     for s in range(0, K, chunk):
         sl = slice(s, s + chunk)
+        # locality prefilter: a res-9 cell chunk spans a tiny fraction of
+        # the zone, so almost all geometry edges/vertices cannot touch it
+        # — dropping them first shrinks the dense (E, k*L) / (k, L, M)
+        # work by ~10x on the NYC zones
+        lo = cell_lo[sl].min(axis=0) - _EPS
+        hi = cell_hi[sl].max(axis=0) + _EPS
         if M:
-            sgn = d[sl, :, 0, None] * (
-                gverts[None, None, :, 1] - cells[sl, :, 1, None]
-            ) - d[sl, :, 1, None] * (gverts[None, None, :, 0] - cells[sl, :, 0, None])
-            strict = np.all((sgn > _EPS) | ~jmask[sl, :, None], axis=1)  # (k, M)
-            vin[sl] = strict.any(axis=1)
+            vm = (
+                (gverts[:, 0] >= lo[0])
+                & (gverts[:, 0] <= hi[0])
+                & (gverts[:, 1] >= lo[1])
+                & (gverts[:, 1] <= hi[1])
+            )
+            gv = gverts[vm]
+            if gv.shape[0]:
+                sgn = d[sl, :, 0, None] * (
+                    gv[None, None, :, 1] - cells[sl, :, 1, None]
+                ) - d[sl, :, 1, None] * (
+                    gv[None, None, :, 0] - cells[sl, :, 0, None]
+                )
+                strict = np.all(
+                    (sgn > _EPS) | ~jmask[sl, :, None], axis=1
+                )  # (k, M')
+                vin[sl] = strict.any(axis=1)
         if E:
-            ca_f = cells[sl].reshape(-1, 2)
-            cb_f = cb[sl].reshape(-1, 2)
-            cm = _segments_cross(ga, gb, ca_f, cb_f)  # (E, k*L)
-            cm &= jmask[sl].reshape(-1)[None, :]
-            crossing[sl] = cm.any(axis=0).reshape(-1, L).any(axis=1)
+            em = ~(
+                (ehi[:, 0] < lo[0])
+                | (elo[:, 0] > hi[0])
+                | (ehi[:, 1] < lo[1])
+                | (elo[:, 1] > hi[1])
+            )
+            ga_c, gb_c = ga[em], gb[em]
+            if ga_c.shape[0]:
+                ca_f = cells[sl].reshape(-1, 2)
+                cb_f = cb[sl].reshape(-1, 2)
+                cm = _segments_cross(ga_c, gb_c, ca_f, cb_f)  # (E', k*L)
+                cm &= jmask[sl].reshape(-1)[None, :]
+                crossing[sl] = cm.any(axis=0).reshape(-1, L).any(axis=1)
 
     is_core = all_in & ~crossing & ~vin
     is_border = ~is_core & (any_in | crossing | vin | centers_in)
