@@ -11,6 +11,8 @@ reduction back to geometry pairs stays on host in `sql.overlay`).
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
@@ -27,6 +29,27 @@ def _pair_specs(names) -> DeviceGeometry:
         n_rings=row,
         geom_type=row,
         shift=P(),
+    )
+
+
+def _pad_pair_axis(dg: DeviceGeometry, pad: int) -> DeviceGeometry:
+    """Grow every pair-axis leaf by ``pad`` empty rows, by field identity.
+
+    The shared (2,) ``shift`` keeps its invariant shape — it is not a pair
+    column, even when the pair count happens to equal 2.
+    """
+
+    def grow(x):
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return jax.numpy.pad(x, widths)
+
+    return dataclasses.replace(
+        dg,
+        **{
+            f.name: grow(getattr(dg, f.name))
+            for f in dataclasses.fields(dg)
+            if f.name != "shift"
+        },
     )
 
 
@@ -47,17 +70,9 @@ def distributed_pair_intersects(
     n = int(da.verts.shape[0])
     total = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     pad = (-n) % total
-
-    def pad_rows(x):
-        # only the pair-axis leaves grow; the shared (2,) shift must not
-        if x.ndim == 0 or x.shape[0] != n:
-            return x
-        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
-        return jax.numpy.pad(x, widths)
-
     if pad:
-        da = jax.tree.map(pad_rows, da)
-        db = jax.tree.map(pad_rows, db)
+        da = _pad_pair_axis(da, pad)
+        db = _pad_pair_axis(db, pad)
 
     spec = _pair_specs(mesh.axis_names)
 

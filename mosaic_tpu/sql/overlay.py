@@ -114,9 +114,14 @@ def intersects_join(
     hit = sure.copy()
     # a geometry pair already accepted via a core chip in ANY shared cell
     # needs no predicate for its remaining border-border candidates
-    pair_key = lgeom.astype(np.int64) << 32 | rgeom.astype(np.int64)
-    decided = np.isin(pair_key, pair_key[sure])
-    need = np.nonzero(~sure & ~decided)[0]
+    # (pair identity via unique-inverse on the 2-column array — exact for
+    # any row-id width, no packed-key collisions)
+    uniq_pairs, pair_id = np.unique(
+        np.stack([lgeom, rgeom], axis=-1), axis=0, return_inverse=True
+    )
+    decided = np.zeros(uniq_pairs.shape[0], bool)
+    decided[pair_id[sure]] = True
+    need = np.nonzero(~sure & ~decided[pair_id])[0]
     if need.shape[0]:
         from ..functions.geometry import st_intersects
 
@@ -125,5 +130,4 @@ def intersects_join(
         a = lt.chips.take(lrows[need])
         b = rt.chips.take(rrows[need])
         hit[need] = np.asarray(st_intersects(a, b, backend=backend))
-    pairs = np.stack([lgeom[hit], rgeom[hit]], axis=-1)
-    return np.unique(pairs, axis=0)
+    return uniq_pairs[np.unique(pair_id[hit])]
