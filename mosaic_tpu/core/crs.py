@@ -28,6 +28,12 @@ from each definition's area of use instead of shipping a static CSV: the
 projected envelope is obtained by transforming a densified boundary of the
 geographic envelope, which covers every registered code (the reference
 ships 3,288 static rows, `core/crs/CRSBoundsProvider.scala:70-95`).
+
+Arbitrary EPSG codes beyond the hand-registered set resolve through the
+parameter-driven constructor in `crs_proj`: a PROJ.4-string parser over
+the same projection kernels (plus general Mercator), 7-parameter Helmert
+datum shifts (``+towgs84``), unit scaling, a built-in EPSG table, and
+`register_crs` for runtime registration of any further code.
 """
 
 from __future__ import annotations
@@ -274,19 +280,30 @@ def _phi_from_q(q, e, xp, iters: int = 8):
     return phi
 
 
+def _lcc_consts(p):
+    """(n, F, rho0) for the conic; the 1SP limit lat1 == lat2 has
+    n = sin(lat1) (the 2SP quotient degenerates to 0/0 there)."""
+    a, e, lat0, lon0, lat1, lat2, fe, fn = p
+    e2 = e * e
+    m1 = _m_fn(np.asarray(lat1), e2, np)
+    t0 = _ts_fn(np.asarray(lat0), e, np)
+    t1 = _ts_fn(np.asarray(lat1), e, np)
+    if abs(lat1 - lat2) < 1e-12:
+        n = np.sin(lat1)
+    else:
+        m2 = _m_fn(np.asarray(lat2), e2, np)
+        t2 = _ts_fn(np.asarray(lat2), e, np)
+        n = (np.log(m1) - np.log(m2)) / (np.log(t1) - np.log(t2))
+    F = m1 / (n * t1**n)
+    rho0 = a * F * t0**n
+    return n, F, rho0
+
+
 def lcc2sp_forward(p, lonlat, xp=np):
     """Lambert conformal conic, 2 standard parallels (Snyder 15)."""
     a, e, lat0, lon0, lat1, lat2, fe, fn = p
     lon, lat = lonlat[..., 0], lonlat[..., 1]
-    e2 = e * e
-    m1 = _m_fn(np.asarray(lat1), e2, np)
-    m2 = _m_fn(np.asarray(lat2), e2, np)
-    t0 = _ts_fn(np.asarray(lat0), e, np)
-    t1 = _ts_fn(np.asarray(lat1), e, np)
-    t2 = _ts_fn(np.asarray(lat2), e, np)
-    n = (np.log(m1) - np.log(m2)) / (np.log(t1) - np.log(t2))
-    F = m1 / (n * t1**n)
-    rho0 = a * F * t0**n
+    n, F, rho0 = _lcc_consts(p)
     t = _ts_fn(lat, e, xp)
     rho = a * F * t**n
     th = n * (lon - lon0)
@@ -295,15 +312,7 @@ def lcc2sp_forward(p, lonlat, xp=np):
 
 def lcc2sp_inverse(p, en, xp=np):
     a, e, lat0, lon0, lat1, lat2, fe, fn = p
-    e2 = e * e
-    m1 = _m_fn(np.asarray(lat1), e2, np)
-    m2 = _m_fn(np.asarray(lat2), e2, np)
-    t0 = _ts_fn(np.asarray(lat0), e, np)
-    t1 = _ts_fn(np.asarray(lat1), e, np)
-    t2 = _ts_fn(np.asarray(lat2), e, np)
-    n = (np.log(m1) - np.log(m2)) / (np.log(t1) - np.log(t2))
-    F = m1 / (n * t1**n)
-    rho0 = a * F * t0**n
+    n, F, rho0 = _lcc_consts(p)
     x = en[..., 0] - fe
     y = rho0 - (en[..., 1] - fn)
     rho = np.sign(n) * xp.sqrt(x * x + y * y)
@@ -431,6 +440,23 @@ def laea_inverse(p, en, xp=np):
     at_center = rho < 1e-9
     lat = xp.where(at_center, lat0, lat)
     lon = xp.where(at_center, lon0, lon)
+    return xp.stack([lon, lat], axis=-1)
+
+
+def merc_forward(p, lonlat, xp=np):
+    """Mercator (Snyder 7), ellipsoidal; spherical falls out at e = 0."""
+    a, e, k0, lon0, fe, fn = p
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    x = fe + a * k0 * (lon - lon0)
+    y = fn - a * k0 * xp.log(_ts_fn(lat, e, xp))
+    return xp.stack([x, y], axis=-1)
+
+
+def merc_inverse(p, en, xp=np):
+    a, e, k0, lon0, fe, fn = p
+    ts = xp.exp(-(en[..., 1] - fn) / (a * k0))
+    lat = _phi_from_ts(ts, e, xp)
+    lon = lon0 + (en[..., 0] - fe) / (a * k0)
     return xp.stack([lon, lat], axis=-1)
 
 
@@ -738,6 +764,31 @@ def _is_utm(srid: int) -> bool:
 _WEBMERC = {3857, 3785, 900913, 102100}  # common aliases
 
 
+def _proj_lookup(srid: int):
+    """Parameter-driven fallthrough: the PROJ-string registry + EPSG
+    table in `crs_proj` (lazy import — crs_proj imports this module)."""
+    from . import crs_proj
+
+    return crs_proj.lookup(srid)
+
+
+def _registered_override(srid: int):
+    """Runtime `register_crs` definitions take precedence over every
+    built-in path, so a user can override natively-handled codes too."""
+    from . import crs_proj
+
+    return crs_proj._REGISTERED.get(srid)
+
+
+def register_crs(srid: int, proj_string: str, area: tuple | None = None):
+    """Register any EPSG/custom code from its PROJ.4 string (see
+    `crs_proj.register_crs`); it becomes usable in `transform_points`,
+    `st_transform` and `crs_bounds` immediately."""
+    from . import crs_proj
+
+    return crs_proj.register_crs(srid, proj_string, area)
+
+
 def supported(srid: int) -> bool:
     return (
         srid in _GEOGRAPHIC
@@ -746,6 +797,7 @@ def supported(srid: int) -> bool:
         or srid in _NAMED
         or srid in _NAMED_TM
         or _is_utm(srid)
+        or _proj_lookup(srid) is not None
     )
 
 
@@ -754,11 +806,17 @@ _FAMILY_FNS = {
     "albers": (albers_forward, albers_inverse),
     "laea": (laea_forward, laea_inverse),
     "stere_polar": (stere_polar_forward, stere_polar_inverse),
+    "merc": (merc_forward, merc_inverse),
 }
 
 
 def to_wgs84(xy, srid: int, xp=np):
     """(N,2) coords in `srid` -> (N,2) lon/lat degrees WGS84."""
+    reg = _registered_override(srid)
+    if reg is not None:
+        from . import crs_proj
+
+        return crs_proj.crs_to_wgs84(reg, xy, xp)
     if srid in _GEOGRAPHIC:
         return xy
     if srid in _WEBMERC:
@@ -776,11 +834,21 @@ def to_wgs84(xy, srid: int, xp=np):
     fam = _utm_family(srid)
     if fam is not None:
         return xp.degrees(tm_inverse(fam[0], xy, xp))
+    crs = _proj_lookup(srid)
+    if crs is not None:
+        from . import crs_proj
+
+        return crs_proj.crs_to_wgs84(crs, xy, xp)
     raise ValueError(f"unsupported SRID {srid}")
 
 
 def from_wgs84(lonlat_deg, srid: int, xp=np):
     """(N,2) lon/lat degrees WGS84 -> (N,2) coords in `srid`."""
+    reg = _registered_override(srid)
+    if reg is not None:
+        from . import crs_proj
+
+        return crs_proj.crs_from_wgs84(reg, lonlat_deg, xp)
     if srid in _GEOGRAPHIC:
         return lonlat_deg
     if srid in _WEBMERC:
@@ -800,6 +868,11 @@ def from_wgs84(lonlat_deg, srid: int, xp=np):
     fam = _utm_family(srid)
     if fam is not None:
         return tm_forward(fam[0], xp.radians(lonlat_deg), xp)
+    crs = _proj_lookup(srid)
+    if crs is not None:
+        from . import crs_proj
+
+        return crs_proj.crs_from_wgs84(crs, lonlat_deg, xp)
     raise ValueError(f"unsupported SRID {srid}")
 
 
@@ -871,6 +944,12 @@ def crs_bounds(srid: int, reprojected: bool) -> tuple[float, float, float, float
     its projected envelope by transforming a densified boundary of its
     geographic area of use (replacing the reference's 3,288-row static
     `CRSBounds.csv`)."""
+    reg = _registered_override(srid)
+    if reg is not None:
+        from . import crs_proj
+
+        geo = reg.area or crs_proj.default_area(reg)
+        return _projected_bounds(srid, geo) if reprojected else geo
     if srid in _WEBMERC:
         srid = 3857  # aliases share the canonical bounds entry
     if srid in _BOUNDS:
@@ -885,6 +964,12 @@ def crs_bounds(srid: int, reprojected: bool) -> tuple[float, float, float, float
         fam = _utm_family(srid)
         if fam is not None:
             geo = fam[1]
+    if geo is None:
+        crs = _proj_lookup(srid)
+        if crs is not None:
+            from . import crs_proj
+
+            geo = crs.area or crs_proj.default_area(crs)
     if geo is None:
         raise ValueError(f"no bounds for SRID {srid}")
     return _projected_bounds(srid, geo) if reprojected else geo

@@ -1,0 +1,216 @@
+"""Parameter-driven CRS engine: PROJ-string parsing, the built-in EPSG
+table, runtime registration, and parity with the reference's bounds table.
+
+Reference analogs: proj4j arbitrary-EPSG reprojection
+(`core/geometry/MosaicGeometry.scala:102-128`) and `CRSBounds.csv`
+(`core/crs/CRSBoundsProvider.scala:18-100`); the spot values below are
+that CSV's rows for codes the table implements.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core import crs
+from mosaic_tpu.core.crs_proj import (
+    lookup,
+    parse_proj,
+    register_crs,
+)
+
+# (geo area, reprojected bounds) per reference CRSBounds.csv
+_CSV_ROWS = {
+    3067: (50199.4814, 6582464.0358, 761274.6247, 7799839.8902),
+    3301: (370753.1145, 6382922.7769, 739245.6000, 6624811.0577),
+    3763: (-121656.5849, -294200.8899, 172945.8815, 277430.8421),
+    2039: (123979.2782, 378130.9791, 265568.0471, 797585.3732),
+    2177: (6390979.5111, 5466989.5093, 6609020.4889, 6078869.0066),
+    2248: (593655.7373, 84146.0734, 1895381.6422, 757391.3704),
+    2263: (909126.0155, 110626.2880, 1610215.3590, 424498.0529),
+    26985: (180946.6307, 25647.7745, 577713.4801, 230853.3514),
+    31370: (17736.0314, 23697.0977, 297289.9391, 245375.4223),
+    31466: (2490547.1867, 5440321.7879, 2609576.6008, 5958700.0208),
+    32198: (-886251.0296, 180252.9126, 897177.3418, 2106143.8139),
+    32118: (277102.1637, 33718.9600, 490794.6230, 129387.2653),
+}
+
+_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [28355, 31983, 7855, 31970, 3395, 3435]
+
+
+def _interior_grid(srid, n=7, margin=0.25):
+    x0, y0, x1, y1 = crs.crs_bounds(srid, reprojected=False)
+    xs = np.linspace(x0 + margin, x1 - margin, n)
+    ys = np.linspace(y0 + margin, y1 - margin, n)
+    return np.stack(np.meshgrid(xs, ys), -1).reshape(-1, 2)
+
+
+@pytest.mark.parametrize("srid", _ROUNDTRIP_CODES)
+def test_roundtrip_below_microdegree(srid):
+    ll = _interior_grid(srid)
+    rt = crs.to_wgs84(crs.from_wgs84(ll, srid), srid)
+    assert np.abs(rt - ll).max() < 1e-6
+    assert crs.supported(srid)
+
+
+@pytest.mark.parametrize("srid", sorted(_CSV_ROWS))
+def test_reprojected_bounds_match_reference_csv(srid):
+    """Computed projected envelopes vs the reference's static rows.
+
+    The computed envelope densifies the area boundary, so it may exceed
+    the CSV (which under-covers conic edge extrema — e.g. 32198's bottom
+    parallel bulges below both corners) but must contain it and stay
+    within 6% of the span on every side.
+    """
+    want = np.array(_CSV_ROWS[srid])
+    got = np.array(crs.crs_bounds(srid, reprojected=True))
+    span = np.array([want[2] - want[0], want[3] - want[1]] * 2)
+    slack = 0.005 * span
+    assert (got[:2] <= want[:2] + slack[:2]).all(), (got, want)
+    assert (got[2:] >= want[2:] - slack[2:]).all(), (got, want)
+    assert (np.abs(got - want) <= 0.06 * span).all(), (got, want)
+
+
+def test_bng_proj_string_matches_native_path():
+    """27700 built from its PROJ string (+datum=OSGB36 Helmert) must agree
+    with the hand-written OSGB36 path to sub-mm."""
+    from mosaic_tpu.core.crs_proj import crs_from_wgs84, crs_to_wgs84
+
+    p = parse_proj(
+        "+proj=tmerc +lat_0=49 +lon_0=-2 +k=0.9996012717 "
+        "+x_0=400000 +y_0=-100000 +datum=OSGB36"
+    )
+    ll = np.array([[-1.5, 52.0], [0.1, 51.5], [-5.0, 50.1], [-3.2, 58.6]])
+    native = crs.from_wgs84(ll, 27700)
+    via = crs_from_wgs84(p, ll)
+    assert np.abs(native - via).max() < 1e-3
+    back = crs_to_wgs84(p, via)
+    assert np.abs(back - ll).max() < 1e-7
+
+
+def test_ellipsoidal_vs_spherical_mercator():
+    # 3395 (ellipsoidal) northing differs from 3857 (spherical) by ~0.3%
+    ll = np.array([[10.0, 45.0]])
+    y_sph = crs.from_wgs84(ll, 3857)[0, 1]
+    y_ell = crs.from_wgs84(ll, 3395)[0, 1]
+    assert abs(y_sph - y_ell) / y_sph > 0.002
+    # eastings agree exactly (same a, k0=1, lon_0=0)
+    assert np.isclose(crs.from_wgs84(ll, 3395)[0, 0], ll[0, 0] / 180 * np.pi * 6378137)
+
+
+def test_lcc_one_sp_center_and_scale():
+    p = parse_proj(
+        "+proj=lcc +lat_1=18 +lat_0=18 +lon_0=-77 +k_0=0.9995 "
+        "+x_0=250000 +y_0=150000 +ellps=clrk66"
+    )
+    from mosaic_tpu.core.crs_proj import crs_from_wgs84, crs_to_wgs84
+
+    # the natural origin maps exactly to the false origin
+    en = crs_from_wgs84(p, np.array([[-77.0, 18.0]]))
+    assert np.allclose(en, [[250000.0, 150000.0]], atol=1e-6)
+    # k_0 scales distances: 1 degree of longitude at lat0 spans ~0.9995 *
+    # the k_0=1 width
+    p1 = parse_proj(
+        "+proj=lcc +lat_1=18 +lat_0=18 +lon_0=-77 +k_0=1 "
+        "+x_0=250000 +y_0=150000 +ellps=clrk66"
+    )
+    w = crs_from_wgs84(p, np.array([[-76.0, 18.0]]))[0, 0] - 250000.0
+    w1 = crs_from_wgs84(p1, np.array([[-76.0, 18.0]]))[0, 0] - 250000.0
+    assert np.isclose(w / w1, 0.9995, atol=1e-9)
+    ll = np.array([[-78.2, 17.7], [-76.2, 18.4]])
+    assert np.abs(crs_to_wgs84(p, crs_from_wgs84(p, ll)) - ll).max() < 1e-9
+
+
+def test_us_survey_foot_units():
+    # 2248 is 26985 expressed in US survey feet
+    ll = np.array([[-76.6, 39.3]])
+    m = crs.from_wgs84(ll, 26985)
+    ft = crs.from_wgs84(ll, 2248)
+    assert np.allclose(ft * 1200.0 / 3937.0, m, atol=1e-6)
+
+
+def test_register_crs_runtime_and_functions_api():
+    from mosaic_tpu.functions import formats as FF
+    from mosaic_tpu.functions import geometry as F
+
+    srid = 990001  # not a real EPSG code: runtime registration only
+    with pytest.raises(ValueError):
+        crs.to_wgs84(np.zeros((1, 2)), srid)
+    register_crs(
+        srid,
+        "+proj=aea +lat_1=34 +lat_2=40.5 +lat_0=0 +lon_0=-120 "
+        "+x_0=0 +y_0=-4000000 +ellps=GRS80",
+        area=(-124.45, 32.53, -114.12, 42.01),
+    )
+    assert crs.supported(srid)
+    # matches the hand-registered California Albers (3310) bit for bit
+    ll = _interior_grid(3310, n=5)
+    assert np.allclose(
+        crs.from_wgs84(ll, srid), crs.from_wgs84(ll, 3310), atol=1e-9
+    )
+    wkt_pt = ["POINT (-120.5 37.2)"]
+    moved = FF.st_astext(F.st_updatesrid(wkt_pt, 4326, srid))
+    assert "POINT" in moved[0]
+    ok = F.st_hasvalidcoordinates(wkt_pt, srid, which="bounds")
+    assert ok.tolist() == [True]
+
+
+def test_register_crs_overrides_builtin_codes():
+    """A runtime registration must take precedence over the native path
+    (e.g. swapping a null datum shift for a real one)."""
+    from mosaic_tpu.core import crs_proj
+
+    ll = np.array([[15.0, 52.0]])
+    builtin = crs.from_wgs84(ll, 32633)
+    try:
+        register_crs(
+            32633, "+proj=utm +zone=33 +ellps=WGS84 +towgs84=100,0,0"
+        )
+        overridden = crs.from_wgs84(ll, 32633)
+        assert np.abs(overridden - builtin).max() > 10.0  # shift applied
+        assert crs.crs_bounds(32633, reprojected=False)[1] == -80.0
+    finally:
+        del crs_proj._REGISTERED[32633]
+        crs._PROJ_BOUNDS_CACHE.pop(32633, None)
+    assert np.allclose(crs.from_wgs84(ll, 32633), builtin)
+
+
+def test_parse_errors_are_loud():
+    with pytest.raises(ValueError, match="implemented families"):
+        parse_proj("+proj=krovak +ellps=bessel")
+    with pytest.raises(ValueError, match="prime meridian"):
+        parse_proj("+proj=lcc +lat_1=49 +lat_2=44 +pm=paris")
+    with pytest.raises(ValueError, match="towgs84"):
+        parse_proj("+proj=tmerc +towgs84=1,2")
+    with pytest.raises(ValueError, match="ellps"):
+        parse_proj("+proj=tmerc +ellps=marsoid")
+    with pytest.raises(ValueError, match="polar"):
+        parse_proj("+proj=stere +lat_0=52.15616055555555 +ellps=bessel")
+    with pytest.raises(ValueError, match="zone"):
+        parse_proj("+proj=utm +zone=61")
+
+
+def test_unknown_code_still_raises():
+    assert lookup(999999) is None
+    with pytest.raises(ValueError, match="unsupported SRID"):
+        crs.transform_points(np.zeros((1, 2)), 4326, 999999)
+
+
+def test_proj_table_code_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    ll = _interior_grid(3067, n=4)
+    want = crs.from_wgs84(ll, 3067)
+    got = jax.jit(lambda x: crs.from_wgs84(x, 3067, xp=jnp))(
+        jnp.asarray(ll)
+    )
+    assert np.abs(np.asarray(got) - want).max() < 1e-6
+
+
+def test_datum_shift_geographic_crs():
+    # 4277 (OSGB36 geographic): shifting Greenwich to WGS84 moves it ~100 m
+    ll_osgb = np.array([[0.0, 51.4778]])
+    ll_wgs = crs.to_wgs84(ll_osgb, 4277)
+    d = np.abs(ll_wgs - ll_osgb)
+    assert 1e-4 < d.max() < 3e-3  # offset is O(100 m), not 0, not huge
+    back = crs.from_wgs84(ll_wgs, 4277)
+    assert np.abs(back - ll_osgb).max() < 1e-7
