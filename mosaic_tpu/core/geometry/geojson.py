@@ -72,8 +72,21 @@ def _append_geojson(builder: GeometryBuilder, obj: dict | None, srid: int) -> No
             for xy, z in _rings_of(poly, drop_close=True):
                 builder.add_ring(xy, z)
             builder.end_part()
-    else:
-        raise NotImplementedError("GeometryCollection GeoJSON")
+    elif gtype == GeometryType.GEOMETRYCOLLECTION:
+        subs = obj.get("geometries", [])
+        if subs:  # reference first-polygonal semantics
+            from .collection import end_collection
+
+            members = []
+            for sobj in subs:
+                sub = GeometryBuilder()
+                _append_geojson(sub, sobj, srid)
+                members.append(
+                    (GeometryType.from_name(sobj["type"]), sub.build())
+                )
+            end_collection(builder, members, srid)
+            return
+        builder.end_part()  # empty collection: keep the GC type
     builder.end_geom(gtype, srid)
 
 

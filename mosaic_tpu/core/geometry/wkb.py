@@ -73,7 +73,11 @@ def _split_xyz(pts: np.ndarray, has_z: bool = True) -> tuple[np.ndarray, np.ndar
     return xy, z
 
 
-def _append_wkb(builder: GeometryBuilder, r: _Reader, default_srid: int) -> None:
+def _append_wkb(
+    builder: GeometryBuilder, r: _Reader, default_srid: int
+) -> GeometryType:
+    """Parse one WKB geometry; returns the DECLARED type (a collection
+    resolves per the reference's first-polygonal semantics)."""
     bo, gtype, srid, dims, has_z = _read_header(r)
     srid = srid or default_srid
 
@@ -129,12 +133,21 @@ def _append_wkb(builder: GeometryBuilder, r: _Reader, default_srid: int) -> None
                 raise ValueError(f"invalid WKB: {sgt} inside {gtype}")
     elif gtype == GeometryType.GEOMETRYCOLLECTION:
         n = r.u32(bo)
-        if n:
-            raise NotImplementedError("non-empty GEOMETRYCOLLECTION WKB")
+        if n:  # reference first-polygonal semantics
+            from .collection import end_collection
+
+            members = []
+            for _ in range(n):
+                sub = GeometryBuilder()
+                declared = _append_wkb(sub, r, srid)
+                members.append((declared, sub.build()))
+            end_collection(builder, members, srid)
+            return gtype
         builder.end_part()
     else:
         raise NotImplementedError(f"WKB geometry type {gtype}")
     builder.end_geom(gtype, srid)
+    return gtype
 
 
 def from_wkb(blobs: Sequence[bytes] | bytes, srid: int = 4326) -> PackedGeometry:

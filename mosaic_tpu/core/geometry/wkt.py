@@ -101,18 +101,28 @@ def _append_wkt(builder: GeometryBuilder, wkt: str, srid: int) -> None:
     if m:
         srid = int(m.group(1))
         wkt = wkt[m.end() :]
-    m = _TYPE_RE.match(wkt)
+    _parse_typed(builder, _Cursor(wkt), srid)
+
+
+def _parse_typed(
+    builder: GeometryBuilder, cur: _Cursor, srid: int
+) -> GeometryType:
+    """Parse one typed geometry at the cursor; returns the DECLARED type
+    (a GEOMETRYCOLLECTION resolves per the reference's first-polygonal
+    semantics but still reports itself as a collection to its caller)."""
+    cur.skip_ws()
+    m = _TYPE_RE.match(cur.s, cur.i)
     if not m:
-        raise ValueError(f"invalid WKT: {wkt[:60]!r}")
+        raise ValueError(f"invalid WKT: {cur.s[cur.i : cur.i + 60]!r}")
     gtype = GeometryType.from_name(m.group(1))
     zm = (m.group(2) or "").upper()
     dims = 4 if zm == "ZM" else (3 if zm in ("Z", "M") else 0)
     m_only = zm == "M"
     if m.group(3):  # EMPTY
+        cur.i = m.end()
         builder.end_part()
         builder.end_geom(gtype, srid)
-        return
-    cur = _Cursor(wkt)
+        return gtype
     cur.i = m.end()
 
     close_ring = open_ring  # store rings open-form; drop explicit closing vertex
@@ -171,9 +181,24 @@ def _append_wkt(builder: GeometryBuilder, wkt: str, srid: int) -> None:
                 continue
             break
         cur.expect(")")
-    else:
-        raise NotImplementedError("GEOMETRYCOLLECTION WKT parsing: use st_dump inputs")
+    else:  # GEOMETRYCOLLECTION: reference first-polygonal semantics
+        from .collection import end_collection
+
+        cur.expect("(")
+        members = []
+        while True:
+            sub = GeometryBuilder()
+            declared = _parse_typed(sub, cur, srid)
+            members.append((declared, sub.build()))
+            if cur.peek() == ",":
+                cur.i += 1
+                continue
+            break
+        cur.expect(")")
+        end_collection(builder, members, srid)
+        return gtype
     builder.end_geom(gtype, srid)
+    return gtype
 
 
 def from_wkt(wkts: Sequence[str] | str, srid: int = 4326) -> PackedGeometry:

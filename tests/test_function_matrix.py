@@ -442,3 +442,34 @@ def test_geometry_and_format_sweep(env, goldens):
 def test_raster_and_agg_sweep(env, goldens):
     for name, fn in sorted({**_raster_specs(env), **_agg_specs(env)}.items()):
         _check_golden(goldens, f"rst/{name}", fn())
+
+
+_COLLECTION_WKT = (
+    "GEOMETRYCOLLECTION (POINT (-73.98 40.73), "
+    "POLYGON ((-74.02 40.70, -73.96 40.70, -73.96 40.76, -74.02 40.76, "
+    "-74.02 40.70)), LINESTRING (-74.0 40.7, -73.9 40.8))"
+)
+
+
+def test_geometry_collection_fixture(goldens):
+    """Collection inputs flow through the whole function surface with the
+    reference's first-polygonal semantics (MosaicGeometryJTS.scala:179-192):
+    the polygon member survives, so measures/flatten/tessellate all work."""
+    from mosaic_tpu.core.index import H3
+
+    col = F.st_geomfromwkt([_COLLECTION_WKT])
+    _check_golden(goldens, "geom/collection_area", F.st_area(col))
+    _check_golden(goldens, "geom/collection_flatten", F.flatten_polygons(col))
+    _check_golden(
+        goldens, "geom/collection_tessellate",
+        F.grid_tessellate(col, 7, index=H3),
+    )
+    # the three codecs agree on the coerced result
+    via_wkb = F.st_geomfromwkb(F.st_aswkb(col))
+    via_gj = F.st_geomfromgeojson(F.st_asgeojson(col))
+    np.testing.assert_allclose(
+        np.asarray(col.xy), np.asarray(via_wkb.xy), atol=1e-12
+    )
+    np.testing.assert_allclose(
+        np.asarray(col.xy), np.asarray(via_gj.xy), atol=1e-9
+    )

@@ -143,3 +143,95 @@ def test_feature_collection(tmp_path):
     assert len(col) == 2
     assert props[0]["name"] == "a"
     assert col.geometry_type(1) == GeometryType.POLYGON
+
+
+# ------------------------------------------------------- GeometryCollection
+# Reference semantics (`MosaicGeometryJTS.scala:179-192`): a non-empty
+# collection keeps its FIRST polygonal top-level member, else POLYGON EMPTY.
+
+_GC_WKT = (
+    "GEOMETRYCOLLECTION (POINT (9 9), "
+    "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 1 2, 2 2, 2 1, 1 1)), "
+    "LINESTRING (0 0, 9 9), "
+    "MULTIPOLYGON (((5 5, 6 5, 6 6, 5 6, 5 5))))"
+)
+
+
+def test_collection_wkt_first_polygonal():
+    col = wkt.from_wkt([_GC_WKT])
+    assert col.geometry_type(0) == GeometryType.POLYGON
+    # the hole survives the copy; the later multipolygon is discarded
+    assert wkt.to_wkt(col)[0] == (
+        "POLYGON ((0 0,4 0,4 4,0 4,0 0),(1 1,1 2,2 2,2 1,1 1))"
+    )
+
+
+def test_collection_wkt_multipolygon_first():
+    col = wkt.from_wkt(
+        ["GEOMETRYCOLLECTION (MULTIPOLYGON (((0 0, 2 0, 2 2, 0 2, 0 0)),"
+         " ((3 3, 4 3, 4 4, 3 4, 3 3))), POLYGON ((9 9, 10 9, 10 10, 9 10, 9 9)))"]
+    )
+    assert col.geometry_type(0) == GeometryType.MULTIPOLYGON
+    assert len(list(col.geom_parts(0))) == 2
+
+
+def test_collection_wkt_no_polygonal_is_empty_polygon():
+    col = wkt.from_wkt(
+        ["GEOMETRYCOLLECTION (POINT (1 2), LINESTRING (0 0, 1 1))"]
+    )
+    assert col.geometry_type(0) == GeometryType.POLYGON
+    assert wkt.to_wkt(col)[0] == "POLYGON EMPTY"
+
+
+def test_collection_wkt_nested_collection_not_searched():
+    # the reference's find() only inspects top-level member types, so a
+    # polygon inside a nested collection must NOT be selected
+    col = wkt.from_wkt(
+        ["GEOMETRYCOLLECTION (GEOMETRYCOLLECTION ("
+         "POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))), POINT (5 5))"]
+    )
+    assert col.geometry_type(0) == GeometryType.POLYGON
+    assert wkt.to_wkt(col)[0] == "POLYGON EMPTY"
+
+
+def test_collection_wkb_roundtrip_via_members():
+    import struct
+
+    members = wkt.from_wkt(
+        [
+            "POINT (9 9)",
+            "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 1 2, 2 2, 2 1, 1 1))",
+            "LINESTRING (0 0, 9 9)",
+        ]
+    )
+    blobs = wkb.to_wkb(members)
+    gc = b"\x01" + struct.pack("<I", 7) + struct.pack("<I", len(blobs))
+    gc += b"".join(blobs)
+    col = wkb.from_wkb([gc])
+    assert col.geometry_type(0) == GeometryType.POLYGON
+    want = wkt.from_wkt([_GC_WKT])
+    np.testing.assert_allclose(
+        np.asarray(col.xy), np.asarray(want.xy), atol=1e-12
+    )
+
+
+def test_collection_geojson():
+    doc = {
+        "type": "GeometryCollection",
+        "geometries": [
+            {"type": "Point", "coordinates": [9, 9]},
+            {
+                "type": "Polygon",
+                "coordinates": [
+                    [[0, 0], [4, 0], [4, 4], [0, 4], [0, 0]],
+                    [[1, 1], [1, 2], [2, 2], [2, 1], [1, 1]],
+                ],
+            },
+        ],
+    }
+    col = geojson.from_geojson([doc])
+    assert col.geometry_type(0) == GeometryType.POLYGON
+    assert len(list(col.part_rings(list(col.geom_parts(0))[0]))) == 2
+    # empty collection keeps its type (null-geometry feature encoding)
+    empty = geojson.from_geojson([{"type": "GeometryCollection", "geometries": []}])
+    assert empty.geometry_type(0) == GeometryType.GEOMETRYCOLLECTION
