@@ -135,6 +135,35 @@ def _point_in_ring(pt: np.ndarray, ring: np.ndarray) -> bool:
     return bool(np.count_nonzero(cond & (x < xs)) % 2)
 
 
+def _contour_in_ring(ci: np.ndarray, cj: np.ndarray) -> bool:
+    """Is contour ``ci`` inside ring ``cj``? Result contours touch but never
+    cross, so one point decides — but a vertex of one contour routinely lies
+    ON the other (shared topology), where ray-casting parity is arbitrary
+    (observed: a union's clipped-hole corner vertex got nested as its own
+    shell, inflating the area). Test a candidate point of ``ci`` that is
+    well clear of ``cj``'s boundary: scan vertices + edge midpoints one at
+    a time (O(|cj|) memory, usually one iteration) and stop at the first
+    candidate farther than eps, falling back to the farthest seen."""
+    a = cj
+    ab = np.roll(cj, -1, axis=0) - a
+    den = np.maximum((ab * ab).sum(axis=1), 1e-300)
+    span = cj.max(axis=0) - cj.min(axis=0)
+    eps2 = (1e-7 * max(float(span[0]), float(span[1]), 1e-300)) ** 2
+    mids = 0.5 * (ci + np.roll(ci, -1, axis=0))
+    best_pt, best_d2 = ci[0], -1.0
+    for k in range(2 * ci.shape[0]):
+        pt = ci[k // 2] if k % 2 == 0 else mids[k // 2]
+        ap = pt - a
+        t = np.clip((ap * ab).sum(axis=1) / den, 0.0, 1.0)
+        close = a + t[:, None] * ab
+        d2 = float(((pt - close) ** 2).sum(axis=1).min())
+        if d2 > best_d2:
+            best_d2, best_pt = d2, pt
+        if d2 > eps2:
+            break
+    return _point_in_ring(best_pt, cj)
+
+
 def _nest_contours(contours: list[np.ndarray]) -> list[list[np.ndarray]]:
     """Group flat even-odd contours into [[shell, hole...], ...] polygons.
 
@@ -149,10 +178,9 @@ def _nest_contours(contours: list[np.ndarray]) -> list[list[np.ndarray]]:
         return [[c if ring_signed_area(c) >= 0 else c[::-1]]]
     inside = np.zeros((n, n), dtype=bool)
     for i in range(n):
-        rep = contours[i][0]
         for j in range(n):
             if i != j:
-                inside[i, j] = _point_in_ring(rep, contours[j])
+                inside[i, j] = _contour_in_ring(contours[i], contours[j])
     depth = inside.sum(axis=1)
     polys: list[list[np.ndarray]] = []
     shell_ids = [i for i in range(n) if depth[i] % 2 == 0]
@@ -190,11 +218,19 @@ def _is_polygonal(col: PackedGeometry, g: int) -> bool:
 
 
 # ------------------------------------------------------------- public column ops
-def bool_op(op: int, a: PackedGeometry, b: PackedGeometry) -> PackedGeometry:
-    """Row-wise polygon boolean op between two equal-length columns."""
+def bool_op(
+    op: int, a: PackedGeometry, b: PackedGeometry, fn=None
+) -> PackedGeometry:
+    """Row-wise polygon boolean op between two equal-length columns.
+
+    ``fn`` selects the C entry point — default `mg_bool_op` (the Martinez
+    sweep); `second.clip` passes `mg_eval_clip` (the independent witness
+    clipper) so both engines share this one marshaling seam."""
     if len(a) != len(b):
         raise ValueError("columns must have equal length")
     l = lib()
+    if fn is None:
+        fn = l.mg_bool_op
     out = GeometryBuilder()
     for g in range(len(a)):
         if not (_is_polygonal(a, g) and _is_polygonal(b, g)):
@@ -206,13 +242,13 @@ def bool_op(op: int, a: PackedGeometry, b: PackedGeometry) -> PackedGeometry:
         bxy, bro = _flatten(_geom_rings(b, g))
         oxy, oro = _c_dpp(), _c_lpp()
         onv, onr = ctypes.c_int64(), ctypes.c_int64()
-        rc = l.mg_bool_op(
+        rc = fn(
             op, *_as_ptr(axy, aro), *_as_ptr(bxy, bro),
             ctypes.byref(oxy), ctypes.byref(oro),
             ctypes.byref(onv), ctypes.byref(onr),
         )
         if rc != 0:
-            raise MemoryError("mg_bool_op failed")
+            raise MemoryError("boolean-op native call failed")
         contours = _read_result(l, oxy, oro, onv, onr)
         _emit_polygon(out, _nest_contours(contours), int(a.srid[g]))
     return out.build()

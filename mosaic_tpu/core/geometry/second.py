@@ -51,6 +51,14 @@ def _lib() -> ctypes.CDLL:
         l.mg_eval_distance.argtypes = [
             _c_dpp, _c_lpp, ctypes.c_int64, _c_dpp, ctypes.c_int64, _c_dpp,
         ]
+        l.mg_eval_clip.restype = ctypes.c_int
+        l.mg_eval_clip.argtypes = [
+            ctypes.c_int,
+            _c_dpp, _c_lpp, ctypes.c_int64,
+            _c_dpp, _c_lpp, ctypes.c_int64,
+            ctypes.POINTER(_c_dpp), ctypes.POINTER(_c_lpp),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
         _proto = True
     return l
 
@@ -166,6 +174,31 @@ def contains_points(col: PackedGeometry, g: int, pts: np.ndarray) -> np.ndarray:
             out.ctypes.data_as(_c_u8p),
         )
     return out.astype(bool)
+
+
+def clip(op: int, a: PackedGeometry, b: PackedGeometry) -> PackedGeometry:
+    """Row-wise polygon boolean op via the INDEPENDENT edge-classification
+    clipper (`mg_eval_clip`) — the witness for `hostops.bool_op`'s
+    Martinez sweep. Same op codes (0=intersection 1=union 2=difference
+    3=xor); marshaling/nesting shared through `hostops.bool_op` (the
+    engine independence lives in the C clippers, not the Python seam)."""
+    return hostops.bool_op(op, a, b, fn=_lib().mg_eval_clip)
+
+
+def intersection(a: PackedGeometry, b: PackedGeometry) -> PackedGeometry:
+    return clip(hostops.OP_INTERSECTION, a, b)
+
+
+def union(a: PackedGeometry, b: PackedGeometry) -> PackedGeometry:
+    return clip(hostops.OP_UNION, a, b)
+
+
+def difference(a: PackedGeometry, b: PackedGeometry) -> PackedGeometry:
+    return clip(hostops.OP_DIFFERENCE, a, b)
+
+
+def sym_difference(a: PackedGeometry, b: PackedGeometry) -> PackedGeometry:
+    return clip(hostops.OP_XOR, a, b)
 
 
 def point_distance(col: PackedGeometry, g: int, pts: np.ndarray) -> np.ndarray:

@@ -13,9 +13,15 @@
 // membership tests ignore the flags (even-odd parity handles holes), the
 // signed measures use them.
 
+#include <algorithm>
+#include <array>
 #include <cmath>
 #include <cstdint>
 #include <cstdlib>
+#include <cstring>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
 
 namespace mgeval {
 
@@ -72,6 +78,217 @@ static double segDist2(double px, double py, double x0, double y0, double x1,
   t = t < 0 ? 0 : (t > 1 ? 1 : t);
   double qx = x0 + t * dx - px, qy = y0 + t * dy - py;
   return qx * qx + qy * qy;
+}
+
+// ---------------------------------------------------------------------------
+// Independent boolean operations — the second-engine witness for the
+// Martinez sweep in martinez.cpp. Deliberately a different algorithm
+// family: O(Ea*Eb) pairwise edge subdivision, then per-subedge SIDE
+// MEMBERSHIP classification (a subedge belongs to the result boundary iff
+// op(inA, inB) differs between the two sides of the edge), then greedy
+// leftmost-turn stitching. No sweep line, no transition flags, no shared
+// code with the primary engine — clipping bugs cannot cancel out.
+// ---------------------------------------------------------------------------
+
+struct ClipEdge {
+  double x0, y0, x1, y1;
+};
+
+static void collectEdges(const double* xy, const int64_t* ro, int64_t nr,
+                         std::vector<ClipEdge>& es) {
+  for (int64_t r = 0; r < nr; ++r) {
+    int64_t lo = ro[r], hi = ro[r + 1], n = hi - lo;
+    if (n < 3) continue;
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t j = (i + 1) % n;
+      ClipEdge e{xy[2 * (lo + i)], xy[2 * (lo + i) + 1], xy[2 * (lo + j)],
+                 xy[2 * (lo + j) + 1]};
+      if (e.x0 == e.x1 && e.y0 == e.y1) continue;
+      es.push_back(e);
+    }
+  }
+}
+
+static double coordScale(const double* xy, int64_t nv, double s) {
+  for (int64_t i = 0; i < 2 * nv; ++i) {
+    double v = std::fabs(xy[i]);
+    s = v > s ? v : s;
+  }
+  return s;
+}
+
+// record intersection parameters of one edge pair (proper crossings,
+// touches, collinear overlaps) into the two edges' split lists
+static void splitPair(const ClipEdge& A, const ClipEdge& B, double scale,
+                      std::vector<double>& tA, std::vector<double>& tB) {
+  const double pe = 1e-12;  // parameter epsilon
+  double ax = A.x0, ay = A.y0;
+  double d1x = A.x1 - ax, d1y = A.y1 - ay;
+  double L1 = std::hypot(d1x, d1y);
+  double bx = B.x0, by = B.y0;
+  double d2x = B.x1 - bx, d2y = B.y1 - by;
+  double L2 = std::hypot(d2x, d2y);
+  double denom = d1x * d2y - d1y * d2x;
+  double ex = bx - ax, ey = by - ay;
+  if (std::fabs(denom) > 1e-12 * L1 * L2) {
+    double t = (ex * d2y - ey * d2x) / denom;
+    double s = (ex * d1y - ey * d1x) / denom;
+    if (t > -pe && t < 1 + pe && s > -pe && s < 1 + pe) {
+      tA.push_back(t < 0 ? 0 : (t > 1 ? 1 : t));
+      tB.push_back(s < 0 ? 0 : (s > 1 ? 1 : s));
+    }
+    return;
+  }
+  // parallel: collinear overlap splits both edges at the other's ends
+  if (std::fabs(ex * d1y - ey * d1x) > 1e-12 * scale * L1) return;
+  double La = d1x * d1x + d1y * d1y, Lb = d2x * d2x + d2y * d2y;
+  double u0 = (ex * d1x + ey * d1y) / La;
+  double u1 = ((B.x1 - ax) * d1x + (B.y1 - ay) * d1y) / La;
+  if (u0 > pe && u0 < 1 - pe) tA.push_back(u0);
+  if (u1 > pe && u1 < 1 - pe) tA.push_back(u1);
+  double v0 = (-ex * d2x - ey * d2y) / Lb;
+  double v1 = ((A.x1 - bx) * d2x + (A.y1 - by) * d2y) / Lb;
+  if (v0 > pe && v0 < 1 - pe) tB.push_back(v0);
+  if (v1 > pe && v1 < 1 - pe) tB.push_back(v1);
+}
+
+static void splitParams(const std::vector<ClipEdge>& ea,
+                        const std::vector<ClipEdge>& eb, double scale,
+                        std::vector<std::vector<double>>& ta,
+                        std::vector<std::vector<double>>& tb) {
+  for (size_t i = 0; i < ea.size(); ++i)
+    for (size_t j = 0; j < eb.size(); ++j)
+      splitPair(ea[i], eb[j], scale, ta[i], tb[j]);
+}
+
+// self-subdivision: even-odd inputs may have contours crossing their own
+// polygon's other contours (e.g. a shell passing through a hole); every
+// edge must also split at those crossings or midpoint classification
+// flips mid-subedge
+static void splitSelf(const std::vector<ClipEdge>& es, double scale,
+                      std::vector<std::vector<double>>& ts) {
+  for (size_t i = 0; i < es.size(); ++i)
+    for (size_t j = i + 1; j < es.size(); ++j)
+      splitPair(es[i], es[j], scale, ts[i], ts[j]);
+}
+
+static void subdivide(const std::vector<ClipEdge>& es,
+                      std::vector<std::vector<double>>& ts,
+                      std::vector<ClipEdge>& out) {
+  for (size_t i = 0; i < es.size(); ++i) {
+    auto& t = ts[i];
+    t.push_back(0.0);
+    t.push_back(1.0);
+    std::sort(t.begin(), t.end());
+    double prev = t.front();
+    for (size_t k = 1; k < t.size(); ++k) {
+      double v = t[k];
+      // split points closer than 1e-9 to prev merge into the NEXT
+      // emitted subedge (prev must stay at the last emitted parameter —
+      // advancing it through a cluster would silently drop that span of
+      // boundary and break the stitched ring)
+      if (v - prev > 1e-9) {
+        out.push_back({es[i].x0 + prev * (es[i].x1 - es[i].x0),
+                       es[i].y0 + prev * (es[i].y1 - es[i].y0),
+                       es[i].x0 + v * (es[i].x1 - es[i].x0),
+                       es[i].y0 + v * (es[i].y1 - es[i].y0)});
+        prev = v;
+      }
+    }
+  }
+}
+
+static inline bool opMember(int op, bool a, bool b) {
+  switch (op) {
+    case 0: return a && b;   // intersection
+    case 1: return a || b;   // union
+    case 2: return a && !b;  // difference
+    default: return a != b;  // xor
+  }
+}
+
+struct QKey {
+  int64_t x, y;
+  bool operator==(const QKey& o) const { return x == o.x && y == o.y; }
+};
+struct QKeyHash {
+  size_t operator()(const QKey& k) const {
+    // unsigned arithmetic: the multiply wraps by definition (a signed
+    // int64 product here would overflow, which is UB)
+    uint64_t h = (uint64_t)k.x * 0x9E3779B97F4A7C15ull ^ (uint64_t)k.y;
+    return std::hash<uint64_t>()(h);
+  }
+};
+
+static inline QKey quant(double x, double y, double q) {
+  return {(int64_t)std::llround(x / q), (int64_t)std::llround(y / q)};
+}
+
+struct EdgeKeyHash {
+  size_t operator()(const std::array<int64_t, 4>& k) const {
+    size_t h = 1469598103934665603ull;
+    for (int64_t v : k) {
+      h ^= (size_t)v;
+      h *= 1099511628211ull;
+    }
+    return h;
+  }
+};
+
+// selected, oriented subedges -> closed contours (leftmost-turn walk)
+static void stitch(std::vector<ClipEdge>& kept, double q,
+                   std::vector<std::vector<double>>& rings) {
+  std::unordered_map<QKey, std::vector<size_t>, QKeyHash> at;
+  for (size_t i = 0; i < kept.size(); ++i)
+    at[quant(kept[i].x0, kept[i].y0, q)].push_back(i);
+  std::vector<char> used(kept.size(), 0);
+  for (size_t s = 0; s < kept.size(); ++s) {
+    if (used[s]) continue;
+    std::vector<double> ring;
+    QKey startKey = quant(kept[s].x0, kept[s].y0, q);
+    size_t cur = s;
+    used[s] = 1;
+    ring.push_back(kept[s].x0);
+    ring.push_back(kept[s].y0);
+    bool closed = false;
+    for (size_t guard = 0; guard <= kept.size(); ++guard) {
+      QKey end = quant(kept[cur].x1, kept[cur].y1, q);
+      if (end == startKey) {
+        closed = true;
+        break;
+      }
+      // candidates at the end point (search the 3x3 quant neighborhood:
+      // intersection points computed from the A- and B-side parameters
+      // can straddle a lattice boundary)
+      double dix = kept[cur].x1 - kept[cur].x0;
+      double diy = kept[cur].y1 - kept[cur].y0;
+      size_t best = SIZE_MAX;
+      double bestAng = -1e18;
+      for (int64_t ddx = -1; ddx <= 1; ++ddx)
+        for (int64_t ddy = -1; ddy <= 1; ++ddy) {
+          auto it = at.find({end.x + ddx, end.y + ddy});
+          if (it == at.end()) continue;
+          for (size_t c : it->second) {
+            if (used[c]) continue;
+            double dcx = kept[c].x1 - kept[c].x0;
+            double dcy = kept[c].y1 - kept[c].y0;
+            // leftmost turn keeps the tightest member-on-left region
+            double ang =
+                std::atan2(dix * dcy - diy * dcx, dix * dcx + diy * dcy);
+            if (ang > bestAng) {
+              bestAng = ang;
+              best = c;
+            }
+          }
+        }
+      if (best == SIZE_MAX) break;  // open chain: numerical orphan, drop
+      used[best] = 1;
+      ring.push_back(kept[best].x0);
+      ring.push_back(kept[best].y0);
+      cur = best;
+    }
+    if (closed && ring.size() >= 6) rings.push_back(std::move(ring));
+  }
 }
 
 }  // namespace mgeval
@@ -178,6 +395,83 @@ int mg_eval_distance(const double* xy, const int64_t* ro, int64_t nr,
       }
     }
     out[i] = std::isfinite(d2) ? std::sqrt(d2) : NAN;
+  }
+  return 0;
+}
+
+// Independent polygon boolean op (see the block comment above): same ABI
+// and output convention as capi.cpp's mg_bool_op (flat contours, malloc'd,
+// released via mg_free_result); ops 0=inter 1=union 2=diff 3=xor.
+int mg_eval_clip(int op, const double* axy, const int64_t* aro, int64_t anr,
+                 const double* bxy, const int64_t* bro, int64_t bnr,
+                 double** out_xy, int64_t** out_ro, int64_t* out_nv,
+                 int64_t* out_nr) {
+  using namespace mgeval;
+  std::vector<ClipEdge> ea, eb;
+  collectEdges(axy, aro, anr, ea);
+  collectEdges(bxy, bro, bnr, eb);
+  double scale = coordScale(axy, anr ? aro[anr] : 0, 1.0);
+  scale = coordScale(bxy, bnr ? bro[bnr] : 0, scale);
+  const double off = 1e-9 * scale;  // classification offset + quant grid
+
+  std::vector<std::vector<double>> ta(ea.size()), tb(eb.size());
+  splitParams(ea, eb, scale, ta, tb);
+  splitSelf(ea, scale, ta);
+  splitSelf(eb, scale, tb);
+  std::vector<ClipEdge> subs;
+  subdivide(ea, ta, subs);
+  subdivide(eb, tb, subs);
+
+  // keep a subedge iff result-membership differs across it; orient the
+  // member side to the LEFT; dedup shared (collinear) copies
+  std::vector<ClipEdge> kept;
+  std::unordered_set<std::array<int64_t, 4>, EdgeKeyHash> seen;
+  for (const ClipEdge& e : subs) {
+    double mx = 0.5 * (e.x0 + e.x1), my = 0.5 * (e.y0 + e.y1);
+    double dx = e.x1 - e.x0, dy = e.y1 - e.y0;
+    double L = std::hypot(dx, dy);
+    double nx = -dy / L * off, ny = dx / L * off;  // left normal
+    bool inAl = evenOddInside(axy, aro, anr, mx + nx, my + ny);
+    bool inBl = evenOddInside(bxy, bro, bnr, mx + nx, my + ny);
+    bool inAr = evenOddInside(axy, aro, anr, mx - nx, my - ny);
+    bool inBr = evenOddInside(bxy, bro, bnr, mx - nx, my - ny);
+    bool ml = opMember(op, inAl, inBl), mr = opMember(op, inAr, inBr);
+    if (ml == mr) continue;
+    ClipEdge k = ml ? e : ClipEdge{e.x1, e.y1, e.x0, e.y0};
+    QKey q0 = quant(k.x0, k.y0, off), q1 = quant(k.x1, k.y1, off);
+    if (!seen.insert({q0.x, q0.y, q1.x, q1.y}).second) continue;
+    kept.push_back(k);
+  }
+
+  std::vector<std::vector<double>> rings;
+  stitch(kept, off, rings);
+
+  int64_t nv = 0;
+  for (auto& r : rings) nv += (int64_t)r.size() / 2;
+  int64_t nr = (int64_t)rings.size();
+  *out_nv = nv;
+  *out_nr = nr;
+  if (!nr) {
+    *out_xy = nullptr;
+    *out_ro = nullptr;
+    return 0;
+  }
+  *out_xy = (double*)std::malloc(sizeof(double) * 2 * nv);
+  *out_ro = (int64_t*)std::malloc(sizeof(int64_t) * (nr + 1));
+  if (!*out_xy || !*out_ro) {
+    std::free(*out_xy);
+    std::free(*out_ro);
+    *out_xy = nullptr;
+    *out_ro = nullptr;
+    return 1;
+  }
+  int64_t o = 0;
+  (*out_ro)[0] = 0;
+  for (int64_t r = 0; r < nr; ++r) {
+    std::memcpy(*out_xy + 2 * o, rings[r].data(),
+                sizeof(double) * rings[r].size());
+    o += (int64_t)rings[r].size() / 2;
+    (*out_ro)[r + 1] = o;
   }
   return 0;
 }

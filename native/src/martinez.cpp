@@ -332,15 +332,32 @@ static void connectEdges(std::vector<SweepEvent*>& sorted, BoolOp op,
       processed[pos] = true;
       if (result[pos]->p == initial) break;
       contour.push_back(result[pos]->p);
-      // find the next unprocessed event sharing this point
+      // Choose the next unprocessed event sharing this point. With four
+      // or more result edges at a vertex (every crossing under XOR; a
+      // subject hole touching its shell under any op) first-found
+      // pairing stitches chains that cross and drops their partners —
+      // take the SHARPEST LEFT TURN from the incoming edge instead,
+      // which pairs edges into non-crossing closed contours.
+      Pt cur = result[pos]->p;
+      Pt prevP = contour[contour.size() - 2];
+      double dix = cur.x - prevP.x, diy = cur.y - prevP.y;
       size_t next = pos;
       bool found = false;
-      for (size_t j = pos + 1; j < result.size() && result[j]->p == result[pos]->p; ++j)
-        if (!processed[j]) { next = j; found = true; break; }
-      if (!found) {
-        for (size_t j = pos; j-- > 0 && result[j]->p == result[pos]->p;)
-          if (!processed[j]) { next = j; found = true; break; }
-      }
+      double bestAng = -1e300;
+      auto consider = [&](size_t j) {
+        if (processed[j]) return;
+        Pt q = result[j]->other->p;
+        double dcx = q.x - cur.x, dcy = q.y - cur.y;
+        double ang = std::atan2(dix * dcy - diy * dcx, dix * dcx + diy * dcy);
+        if (!found || ang > bestAng) {
+          bestAng = ang;
+          next = j;
+          found = true;
+        }
+      };
+      for (size_t j = pos + 1; j < result.size() && result[j]->p == cur; ++j)
+        consider(j);
+      for (size_t j = pos; j-- > 0 && result[j]->p == cur;) consider(j);
       if (!found) break;  // open chain (degenerate); emit what we have
       pos = next;
     }
