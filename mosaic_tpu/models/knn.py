@@ -38,11 +38,16 @@ def _pow2(n: int) -> int:
 
 class GridRingNeighbours:
     """One iteration's candidate generation + distance evaluation
-    (reference: GridRingNeighbours.transform / leftTransform:76-99)."""
+    (reference: GridRingNeighbours.transform / leftTransform:76-99).
 
-    def __init__(self, index: IndexSystem, resolution: int):
+    With ``mesh`` set, each iteration's pair batch shards over the mesh
+    devices (`parallel/dist_knn.py`) — the reference's distributed
+    join+distance step (`SpatialKNN.scala:202-235`)."""
+
+    def __init__(self, index: IndexSystem, resolution: int, mesh=None):
         self.index = index
         self.resolution = resolution
+        self.mesh = mesh
         self._dist_cache: dict[int, object] = {}
 
     # ------------------------------------------------------------ cells
@@ -73,32 +78,25 @@ class GridRingNeighbours:
         import jax
         import jax.numpy as jnp
 
+        from ..core.geometry.device import take_rows
         from ..functions.geometry import _distance_dense, _vmap_pair
 
         P = li.shape[0]
         if P == 0:
             return np.zeros(0)
+        if self.mesh is not None:
+            from ..parallel.dist_knn import distributed_pair_distances
+
+            return distributed_pair_distances(self.mesh, dl, dc, li, ci)
         Ppad = _pow2(P)
         lip = np.concatenate([li, np.zeros(Ppad - P, dtype=li.dtype)])
         cip = np.concatenate([ci, np.zeros(Ppad - P, dtype=ci.dtype)])
 
-        from ..core.geometry.device import DeviceGeometry
-
-        def gather(dg, rows):
-            return DeviceGeometry(
-                verts=dg.verts[rows],
-                ring_len=dg.ring_len[rows],
-                ring_is_hole=dg.ring_is_hole[rows],
-                n_rings=dg.n_rings[rows],
-                geom_type=dg.geom_type[rows],
-                shift=dg.shift,
-            )
-
         key = Ppad
         if key not in self._dist_cache:
             def run(dls, dcs, lrows, crows):
-                da = gather(dls, lrows)
-                db = gather(dcs, crows)
+                da = take_rows(dls, lrows)
+                db = take_rows(dcs, crows)
                 return _vmap_pair(_distance_dense, da, db)
 
             self._dist_cache[key] = jax.jit(run)
@@ -132,6 +130,7 @@ class SpatialKNN:
         distance_threshold: "float | None" = None,
         approximate: bool = True,
         checkpoint_dir: "str | None" = None,
+        mesh=None,
     ):
         if index is None:
             from ..context import current_context
@@ -145,6 +144,9 @@ class SpatialKNN:
         self.distance_threshold = distance_threshold
         self.approximate = approximate
         self.checkpoint_dir = checkpoint_dir
+        #: optional jax.sharding.Mesh: shards every iteration's pair
+        #: batch over its devices (parallel/dist_knn.py)
+        self.mesh = mesh
         self.metrics: dict = {}
 
     # ------------------------------------------------------------ helpers
@@ -183,7 +185,7 @@ class SpatialKNN:
         from ..functions.geometry import _pair_pack
 
         dl, dc = _pair_pack(land, cand)
-        ring = GridRingNeighbours(self.index, res)
+        ring = GridRingNeighbours(self.index, res, mesh=self.mesh)
 
         ckpt = (
             CheckpointManager(self.checkpoint_dir, overwrite=True)

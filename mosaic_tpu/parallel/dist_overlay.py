@@ -20,8 +20,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.geometry.device import DeviceGeometry
 
 
-def _pair_specs(names) -> DeviceGeometry:
-    row = P(names)
+def geom_specs(row: P) -> DeviceGeometry:
+    """DeviceGeometry-shaped PartitionSpec tree: every pair-axis leaf gets
+    ``row`` (shard or replicate), the shared (2,) shift is always
+    replicated. One builder for every mesh consumer of geometry columns
+    (dist_overlay, dist_knn)."""
     return DeviceGeometry(
         verts=row,
         ring_len=row,
@@ -68,13 +71,12 @@ def distributed_pair_intersects(
     from ..functions.geometry import _PAIR_AXES, _vmap_pair
 
     n = int(da.verts.shape[0])
-    total = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
-    pad = (-n) % total
+    pad = (-n) % mesh.size
     if pad:
         da = _pad_pair_axis(da, pad)
         db = _pad_pair_axis(db, pad)
 
-    spec = _pair_specs(mesh.axis_names)
+    spec = geom_specs(P(mesh.axis_names))
 
     def step(a, b):
         return _vmap_pair(_dense, a, b)
