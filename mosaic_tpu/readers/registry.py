@@ -104,7 +104,14 @@ def _fmt_netcdf(path, **kw):
     return read_netcdf(path, variable=kw.get("variable"))
 
 
+def _fmt_kml(path, **kw):
+    from .kml import read_kml
+
+    return read_kml(path)
+
+
 _FORMATS: dict[str, Callable] = {
+    "kml": _fmt_kml,
     "shapefile": _fmt_shapefile,
     "geojson": _fmt_geojson,
     "geopackage": _fmt_geopackage,

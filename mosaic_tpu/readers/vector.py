@@ -324,4 +324,8 @@ def open_any(path: str) -> VectorTable:
         return read_shapefile(path)
     if s.endswith((".json", ".geojson")):
         return read_geojson(path)
+    if s.endswith(".kml"):
+        from .kml import read_kml
+
+        return read_kml(path)
     raise ValueError(f"no reader for {path}")

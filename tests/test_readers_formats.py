@@ -322,3 +322,124 @@ def test_filegdb_layer_listing_and_registry():
     assert len(vt.geometry) == 19890
     with pytest.raises(ValueError):
         read("geodb").option("layer", "nope").load(gdb)
+
+
+# ----------------------------------------------------------------- KML
+_KML_DOC = """<?xml version="1.0" encoding="UTF-8"?>
+<kml xmlns="http://www.opengis.net/kml/2.2">
+ <Document>
+  <Folder>
+   <Placemark>
+    <name>hq</name>
+    <ExtendedData><Data name="kind"><value>office</value></Data></ExtendedData>
+    <Point><coordinates>-73.98,40.75,12.5</coordinates></Point>
+   </Placemark>
+   <Placemark>
+    <name>route</name>
+    <LineString><coordinates>
+      -74.0,40.7 -73.95,40.72 -73.9,40.76
+    </coordinates></LineString>
+   </Placemark>
+  </Folder>
+  <Placemark>
+   <name>zone</name>
+   <ExtendedData><SchemaData><SimpleData name="code">Z1</SimpleData></SchemaData></ExtendedData>
+   <Polygon>
+    <outerBoundaryIs><LinearRing><coordinates>
+      -74.02,40.70 -73.96,40.70 -73.96,40.76 -74.02,40.76 -74.02,40.70
+    </coordinates></LinearRing></outerBoundaryIs>
+    <innerBoundaryIs><LinearRing><coordinates>
+      -74.00,40.72 -73.98,40.72 -73.98,40.74 -74.00,40.74 -74.00,40.72
+    </coordinates></LinearRing></innerBoundaryIs>
+   </Polygon>
+  </Placemark>
+  <Placemark>
+   <name>islands</name>
+   <MultiGeometry>
+    <Polygon><outerBoundaryIs><LinearRing><coordinates>
+      0,0 1,0 1,1 0,1 0,0
+    </coordinates></LinearRing></outerBoundaryIs></Polygon>
+    <Polygon><outerBoundaryIs><LinearRing><coordinates>
+      2,2 3,2 3,3 2,3 2,2
+    </coordinates></LinearRing></outerBoundaryIs></Polygon>
+   </MultiGeometry>
+  </Placemark>
+ </Document>
+</kml>
+"""
+
+
+def test_kml_reader(tmp_path):
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.registry import read
+
+    p = tmp_path / "sample.kml"
+    p.write_text(_KML_DOC)
+    t = read("kml").load(str(p))
+    assert len(t) == 4
+    assert [t.geometry.geometry_type(g) for g in range(4)] == [
+        GeometryType.POINT, GeometryType.LINESTRING,
+        GeometryType.POLYGON, GeometryType.MULTIPOLYGON,
+    ]
+    assert t.columns["name"].tolist() == ["hq", "route", "zone", "islands"]
+    assert t.columns["kind"][0] == "office"
+    assert t.columns["code"][2] == "Z1"
+    # point carries altitude as z, lon/lat order per spec
+    np.testing.assert_allclose(t.geometry.geom_xy(0), [[-73.98, 40.75]])
+    assert t.geometry.has_z(0)
+    # holed polygon: area = outer - inner
+    from mosaic_tpu import functions as F
+
+    a = float(np.asarray(F.st_area(t.geometry.slice(2, 3)))[0])
+    np.testing.assert_allclose(a, 0.06 * 0.06 - 0.02 * 0.02, atol=1e-12)
+    # multipolygon: two parts, total area 2
+    a2 = float(np.asarray(F.st_area(t.geometry.slice(3, 4)))[0])
+    np.testing.assert_allclose(a2, 2.0, atol=1e-12)
+    # srid is fixed to 4326 by the KML spec
+    assert int(t.geometry.srid[2]) == 4326
+
+
+def test_kml_mixed_multigeometry_uses_collection_rule(tmp_path):
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.kml import read_kml
+
+    doc = """<?xml version="1.0"?>
+    <kml xmlns="http://www.opengis.net/kml/2.2"><Document><Placemark>
+     <MultiGeometry>
+      <Point><coordinates>5,5</coordinates></Point>
+      <Polygon><outerBoundaryIs><LinearRing><coordinates>
+        0,0 2,0 2,2 0,2 0,0
+      </coordinates></LinearRing></outerBoundaryIs></Polygon>
+     </MultiGeometry>
+    </Placemark></Document></kml>"""
+    p = tmp_path / "mixed.kml"
+    p.write_text(doc)
+    t = read_kml(p)
+    # first-polygonal rule (shared with the WKT/WKB/GeoJSON codecs)
+    assert t.geometry.geometry_type(0) == GeometryType.POLYGON
+    assert t.geometry.geom_xy(0).shape[0] == 4
+
+
+def test_kml_nested_mixed_multigeometry_and_sloppy_coords(tmp_path):
+    # a nested MIXED MultiGeometry must not win the first-polygonal rule
+    # over a real later Polygon; trailing commas must parse
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.kml import read_kml
+
+    doc = """<?xml version="1.0"?>
+    <kml xmlns="http://www.opengis.net/kml/2.2"><Document><Placemark>
+     <MultiGeometry>
+      <MultiGeometry>
+       <Point><coordinates>5,5,</coordinates></Point>
+       <LineString><coordinates>0,0 1,1</coordinates></LineString>
+      </MultiGeometry>
+      <Polygon><outerBoundaryIs><LinearRing><coordinates>
+        0,0 2,0 2,2 0,2 0,0
+      </coordinates></LinearRing></outerBoundaryIs></Polygon>
+     </MultiGeometry>
+    </Placemark></Document></kml>"""
+    p = tmp_path / "nested.kml"
+    p.write_text(doc)
+    t = read_kml(p)
+    assert t.geometry.geometry_type(0) == GeometryType.POLYGON
+    assert t.geometry.geom_xy(0).shape[0] == 4  # the real polygon won
