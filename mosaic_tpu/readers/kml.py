@@ -19,21 +19,7 @@ from xml.etree import ElementTree
 import numpy as np
 
 from ..core.types import GeometryBuilder, GeometryType, open_ring
-
-
-def _local(tag: str) -> str:
-    return tag.rsplit("}", 1)[-1]
-
-
-def _children(el, name: str):
-    return [c for c in el if _local(c.tag) == name]
-
-
-def _find(el, name: str):
-    for c in el.iter():
-        if _local(c.tag) == name:
-            return c
-    return None
+from ._xml import children as _children, find as _find, local as _local
 
 
 def _coords(el) -> tuple[np.ndarray, np.ndarray | None]:

@@ -110,8 +110,22 @@ def _fmt_kml(path, **kw):
     return read_kml(path)
 
 
+def _fmt_gml(path, **kw):
+    from .gml import read_gml
+
+    return read_gml(path, srid=int(kw.get("srid", 4326)))
+
+
+def _fmt_gpx(path, **kw):
+    from .gml import read_gpx
+
+    return read_gpx(path)
+
+
 _FORMATS: dict[str, Callable] = {
     "kml": _fmt_kml,
+    "gml": _fmt_gml,
+    "gpx": _fmt_gpx,
     "shapefile": _fmt_shapefile,
     "geojson": _fmt_geojson,
     "geopackage": _fmt_geopackage,

@@ -328,4 +328,12 @@ def open_any(path: str) -> VectorTable:
         from .kml import read_kml
 
         return read_kml(path)
+    if s.endswith(".gml"):
+        from .gml import read_gml
+
+        return read_gml(path)
+    if s.endswith(".gpx"):
+        from .gml import read_gpx
+
+        return read_gpx(path)
     raise ValueError(f"no reader for {path}")

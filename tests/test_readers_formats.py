@@ -443,3 +443,164 @@ def test_kml_nested_mixed_multigeometry_and_sloppy_coords(tmp_path):
     t = read_kml(p)
     assert t.geometry.geometry_type(0) == GeometryType.POLYGON
     assert t.geometry.geom_xy(0).shape[0] == 4  # the real polygon won
+
+
+# ----------------------------------------------------------- GML + GPX
+_GML_DOC = """<?xml version="1.0" encoding="utf-8" ?>
+<ogr:FeatureCollection xmlns:gml="http://www.opengis.net/gml"
+                       xmlns:ogr="http://ogr.maptools.org/">
+ <gml:featureMember>
+  <ogr:zone>
+   <ogr:name>alpha</ogr:name>
+   <ogr:pop>120</ogr:pop>
+   <ogr:geometryProperty>
+    <gml:Polygon srsName="EPSG:4326">
+     <gml:exterior><gml:LinearRing>
+      <gml:posList>0 0 4 0 4 4 0 4 0 0</gml:posList>
+     </gml:LinearRing></gml:exterior>
+     <gml:interior><gml:LinearRing>
+      <gml:posList>1 1 1 2 2 2 2 1 1 1</gml:posList>
+     </gml:LinearRing></gml:interior>
+    </gml:Polygon>
+   </ogr:geometryProperty>
+  </ogr:zone>
+ </gml:featureMember>
+ <gml:featureMember>
+  <ogr:stop>
+   <ogr:name>beta</ogr:name>
+   <ogr:geometryProperty>
+    <gml:Point><gml:pos>-73.98 40.75</gml:pos></gml:Point>
+   </ogr:geometryProperty>
+  </ogr:stop>
+ </gml:featureMember>
+ <gml:featureMember>
+  <ogr:path>
+   <ogr:geometryProperty>
+    <gml:LineString>
+     <gml:coordinates>0,0 1,1 2,0</gml:coordinates>
+    </gml:LineString>
+   </ogr:geometryProperty>
+  </ogr:path>
+ </gml:featureMember>
+ <gml:featureMember>
+  <ogr:lakes>
+   <ogr:geometryProperty>
+    <gml:MultiSurface>
+     <gml:surfaceMember><gml:Polygon><gml:exterior><gml:LinearRing>
+      <gml:posList>0 0 1 0 1 1 0 1 0 0</gml:posList>
+     </gml:LinearRing></gml:exterior></gml:Polygon></gml:surfaceMember>
+     <gml:surfaceMember><gml:Polygon><gml:exterior><gml:LinearRing>
+      <gml:posList>3 3 4 3 4 4 3 4 3 3</gml:posList>
+     </gml:LinearRing></gml:exterior></gml:Polygon></gml:surfaceMember>
+    </gml:MultiSurface>
+   </ogr:geometryProperty>
+  </ogr:lakes>
+ </gml:featureMember>
+</ogr:FeatureCollection>
+"""
+
+_GPX_DOC = """<?xml version="1.0"?>
+<gpx xmlns="http://www.topografix.com/GPX/1/1" version="1.1">
+ <wpt lat="40.75" lon="-73.98"><ele>12.5</ele><name>hq</name></wpt>
+ <rte><name>r1</name>
+  <rtept lat="40.7" lon="-74.0"/><rtept lat="40.72" lon="-73.95"/>
+ </rte>
+ <trk><name>t1</name>
+  <trkseg>
+   <trkpt lat="40.60" lon="-74.05"/><trkpt lat="40.61" lon="-74.04"/>
+   <trkpt lat="40.62" lon="-74.02"/>
+  </trkseg>
+ </trk>
+</gpx>
+"""
+
+
+def test_gml_reader(tmp_path):
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.registry import read
+    from mosaic_tpu import functions as F
+
+    p = tmp_path / "sample.gml"
+    p.write_text(_GML_DOC)
+    t = read("gml").load(str(p))
+    assert len(t) == 4
+    assert [t.geometry.geometry_type(g) for g in range(4)] == [
+        GeometryType.POLYGON, GeometryType.POINT,
+        GeometryType.LINESTRING, GeometryType.MULTIPOLYGON,
+    ]
+    assert t.columns["name"].tolist() == ["alpha", "beta", "", ""]
+    assert t.columns["pop"][0] == "120"
+    a = float(np.asarray(F.st_area(t.geometry.slice(0, 1)))[0])
+    np.testing.assert_allclose(a, 16.0 - 1.0, atol=1e-12)
+    a2 = float(np.asarray(F.st_area(t.geometry.slice(3, 4)))[0])
+    np.testing.assert_allclose(a2, 2.0, atol=1e-12)
+    np.testing.assert_allclose(t.geometry.geom_xy(1), [[-73.98, 40.75]])
+
+
+def test_gpx_reader(tmp_path):
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.vector import open_any
+
+    p = tmp_path / "sample.gpx"
+    p.write_text(_GPX_DOC)
+    t = open_any(str(p))
+    assert len(t) == 3
+    assert [t.geometry.geometry_type(g) for g in range(3)] == [
+        GeometryType.POINT, GeometryType.LINESTRING, GeometryType.LINESTRING,
+    ]
+    assert t.columns["kind"].tolist() == ["wpt", "rte", "trkseg"]
+    assert t.columns["name"].tolist() == ["hq", "r1", "t1"]  # trk name rides its segments
+    assert t.geometry.has_z(0)  # ele became z
+    assert t.geometry.geom_xy(2).shape[0] == 3
+
+
+def test_gml_edge_cases(tmp_path):
+    # mixed MultiGeometry -> collection rule; 3D posList via srsDimension
+    # on the Polygon; multi-segment Curve concatenation
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.gml import read_gml
+
+    doc = """<?xml version="1.0"?>
+    <c xmlns:gml="http://www.opengis.net/gml">
+     <gml:featureMember><f><geom>
+      <gml:MultiGeometry>
+       <gml:geometryMember><gml:Point><gml:pos>9 9</gml:pos></gml:Point></gml:geometryMember>
+       <gml:geometryMember><gml:Polygon><gml:exterior><gml:LinearRing>
+         <gml:posList>0 0 2 0 2 2 0 2 0 0</gml:posList>
+       </gml:LinearRing></gml:exterior></gml:Polygon></gml:geometryMember>
+      </gml:MultiGeometry>
+     </geom></f></gml:featureMember>
+     <gml:featureMember><f><geom>
+      <gml:Polygon srsDimension="3"><gml:exterior><gml:LinearRing>
+        <gml:posList>0 0 5 4 0 5 4 4 5 0 4 5 0 0 5</gml:posList>
+      </gml:LinearRing></gml:exterior></gml:Polygon>
+     </geom></f></gml:featureMember>
+     <gml:featureMember><f><geom>
+      <gml:Curve><gml:segments>
+       <gml:LineStringSegment><gml:posList>0 0 1 1</gml:posList></gml:LineStringSegment>
+       <gml:LineStringSegment><gml:posList>1 1 2 0</gml:posList></gml:LineStringSegment>
+      </gml:segments></gml:Curve>
+     </geom></f></gml:featureMember>
+     <gml:featureMember><f><geom>
+      <gml:MultiGeometry>
+       <gml:geometryMember><gml:Point><gml:pos>1 1</gml:pos></gml:Point></gml:geometryMember>
+       <gml:geometryMember><gml:Point><gml:pos>2 2</gml:pos></gml:Point></gml:geometryMember>
+      </gml:MultiGeometry>
+     </geom></f></gml:featureMember>
+    </c>"""
+    p = tmp_path / "edge.gml"
+    p.write_text(doc)
+    t = read_gml(p)
+    assert len(t) == 4
+    g = t.geometry
+    # mixed members: first-polygonal rule keeps the polygon
+    assert g.geometry_type(0) == GeometryType.POLYGON
+    assert g.geom_xy(0).shape[0] == 4
+    # 3D ring: 4 vertices (closing dropped), z preserved
+    assert g.geometry_type(1) == GeometryType.POLYGON
+    assert g.geom_xy(1).shape[0] == 4
+    assert g.has_z(1)
+    # multi-segment curve concatenated, joint vertex deduped
+    np.testing.assert_allclose(g.geom_xy(2), [[0, 0], [1, 1], [2, 0]])
+    # homogeneous point members collapse to MULTIPOINT
+    assert g.geometry_type(3) == GeometryType.MULTIPOINT
