@@ -9,6 +9,7 @@ loops where possible.
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Sequence
 
@@ -210,11 +211,25 @@ def from_wkt(wkts: Sequence[str] | str, srid: int = 4326) -> PackedGeometry:
     return builder.build()
 
 
+def _num(v) -> str:
+    """Shortest string that round-trips the float exactly (Python repr,
+    integral values as bare ints); .15g dropped up to 2 significant
+    digits, so WKT was a lossy codec."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e16:
+        i = str(int(f))
+        # keep -0.0's sign (int() drops it; '%.15g' printed '-0' too)
+        return "-0" if i == "0" and math.copysign(1.0, f) < 0 else i
+    return repr(f)
+
+
 def _fmt_coords(xy: np.ndarray, z: np.ndarray | None, close: bool = False) -> str:
     pts, zz = (_close_ring_xy(xy, z) if close else (xy, z))
     if zz is not None:
-        return ",".join(f"{p[0]:.15g} {p[1]:.15g} {w:.15g}" for p, w in zip(pts, zz))
-    return ",".join(f"{p[0]:.15g} {p[1]:.15g}" for p in pts)
+        return ",".join(
+            f"{_num(p[0])} {_num(p[1])} {_num(w)}" for p, w in zip(pts, zz)
+        )
+    return ",".join(f"{_num(p[0])} {_num(p[1])}" for p in pts)
 
 
 def to_wkt(col: PackedGeometry) -> list[str]:
