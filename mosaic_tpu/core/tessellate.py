@@ -88,22 +88,37 @@ def _geom_rings(col: PackedGeometry, g: int) -> list[tuple[np.ndarray, bool, int
 
 def _even_odd_inside(pts: np.ndarray, rings: list[np.ndarray]) -> np.ndarray:
     """(M,) bool — even-odd crossing test of pts against a set of rings."""
-    M = pts.shape[0]
-    cnt = np.zeros(M, dtype=np.int64)
-    px, py = pts[:, 0][:, None], pts[:, 1][:, None]
-    for ring in rings:
-        if ring.shape[0] < 3:
-            continue
-        a = ring
-        b = np.roll(ring, -1, axis=0)
+    ea = [r for r in rings if r.shape[0] >= 3]
+    if not ea:
+        return np.zeros(pts.shape[0], dtype=bool)
+    a = np.concatenate(ea)
+    b = np.concatenate([np.roll(r, -1, axis=0) for r in ea])
+    return _even_odd_edges(pts, a, b)
+
+
+def _even_odd_edges(pts: np.ndarray, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(M,) bool — even-odd parity of pts against an edge soup (E,2)x2.
+
+    Parity over the concatenation of all rings equals the per-ring sum,
+    so callers may prefilter the edge set to those that can actually
+    cross a +x ray from the query region (y-overlap and not entirely
+    left of it). Points are chunked so the dense (M, E) intermediates
+    stay bounded for unprefiltered callers (polyfill over many-ring
+    multipolygons)."""
+    M, E = pts.shape[0], a.shape[0]
+    if E == 0 or M == 0:
+        return np.zeros(M, dtype=bool)
+    out = np.zeros(M, dtype=bool)
+    step = max(1, int(2e7 // E))
+    for s in range(0, M, step):
+        px, py = pts[s : s + step, 0][:, None], pts[s : s + step, 1][:, None]
         ay, by = a[None, :, 1], b[None, :, 1]
-        ax, bx = a[None, :, 0], b[None, :, 0]
         straddle = (ay > py) != (by > py)
         denom = by - ay
         denom = np.where(denom == 0, 1.0, denom)
-        xc = ax + (py - ay) * (bx - ax) / denom
-        cnt += np.sum(straddle & (px < xc), axis=1)
-    return (cnt & 1) == 1
+        xc = a[None, :, 0] + (py - ay) * (b[None, :, 0] - a[None, :, 0]) / denom
+        out[s : s + step] = (np.sum(straddle & (px < xc), axis=1) & 1) == 1
+    return out
 
 
 def _segments_cross(a0, a1, b0, b1) -> np.ndarray:
@@ -138,6 +153,17 @@ def _segments_cross(a0, a1, b0, b1) -> np.ndarray:
         )
         return (np.abs(c) <= _EPS) & inside
 
+    # touch handling is the expensive half (4 bbox masks) but only
+    # matters where some orientation is collinear — skip it entirely for
+    # the common all-proper case
+    col = (
+        (np.abs(d1) <= _EPS)
+        | (np.abs(d2) <= _EPS)
+        | (np.abs(d3) <= _EPS)
+        | (np.abs(d4) <= _EPS)
+    )
+    if not col.any():
+        return proper
     touch = (
         on_seg(a0, da, b0, d1)
         | on_seg(a0, da, b1, d2)
@@ -247,11 +273,9 @@ def _classify_cells_batch(
 
     idx = np.arange(L)[None, :]
     jmask = idx < klen[:, None]  # (K, L) valid vertices == valid edges
-    corners_in = _even_odd_inside(cells.reshape(-1, 2), ring_arrays).reshape(K, L)
-    all_in = np.all(corners_in | ~jmask, axis=1)
-    any_in = np.any(corners_in & jmask, axis=1)
     centers = cells.sum(axis=1) / klen[:, None]
-    centers_in = _even_odd_inside(centers, ring_arrays)
+    corners_in = np.zeros((K, L), dtype=bool)
+    centers_in = np.zeros(K, dtype=bool)
 
     nxt = np.where(idx + 1 < klen[:, None], idx + 1, 0)
     cb = np.take_along_axis(cells, nxt[:, :, None], axis=1)  # (K, L, 2)
@@ -288,6 +312,21 @@ def _classify_cells_batch(
         # work by ~10x on the NYC zones
         lo = cell_lo[sl].min(axis=0) - _EPS
         hi = cell_hi[sl].max(axis=0) + _EPS
+        if E:
+            # corner/center even-odd parity, prefiltered to edges whose
+            # y-range overlaps the chunk and that are not entirely to its
+            # left (a +x ray can only cross those)
+            pm = (
+                (ehi[:, 1] >= lo[1])
+                & (elo[:, 1] <= hi[1])
+                & (ehi[:, 0] >= lo[0])
+            )
+            pa, pb = ga[pm], gb[pm]
+            k = klen[sl].shape[0]
+            pts = np.concatenate([cells[sl].reshape(-1, 2), centers[sl]])
+            par = _even_odd_edges(pts, pa, pb)
+            corners_in[sl] = par[: k * L].reshape(k, L)
+            centers_in[sl] = par[k * L :]
         if M:
             vm = (
                 (gverts[:, 0] >= lo[0])
@@ -321,6 +360,8 @@ def _classify_cells_batch(
                 cm &= jmask[sl].reshape(-1)[None, :]
                 crossing[sl] = cm.any(axis=0).reshape(-1, L).any(axis=1)
 
+    all_in = np.all(corners_in | ~jmask, axis=1)
+    any_in = np.any(corners_in & jmask, axis=1)
     is_core = all_in & ~crossing & ~vin
     is_border = ~is_core & (any_in | crossing | vin | centers_in)
     return is_core, is_border
@@ -376,7 +417,10 @@ def clip_rings_convex_batch(
         cnt = emit0.astype(np.int64) + emit1.astype(np.int64)
         base = np.cumsum(cnt, axis=1) - cnt  # exclusive
         new_len = cnt.sum(axis=1)
-        W = max(int(new_len.max()), cur.shape[1], 1)
+        # shrink the working width to the widest surviving ring: a tiny
+        # convex window collapses most clipped rings after 2-3 half-planes,
+        # so later rounds run on a fraction of the original ring width
+        W = max(int(np.where(active, new_len, clen).max()), 1)
         buf = np.zeros((K, W, 2))
         k0, j0 = np.nonzero(emit0)
         buf[k0, base[k0, j0]] = cur[k0, j0]
@@ -384,6 +428,8 @@ def clip_rings_convex_batch(
         buf[k1, base[k1, j1] + emit0[k1, j1]] = inter[k1, j1]
         if W > cur.shape[1]:
             cur = np.pad(cur, ((0, 0), (0, W - cur.shape[1]), (0, 0)))
+        elif W < cur.shape[1]:
+            cur = np.ascontiguousarray(cur[:, :W])
         cur = np.where(active[:, None, None], buf, cur)
         clen = np.where(active, new_len, clen)
     jdx = np.arange(cur.shape[1])[None, :]
