@@ -471,25 +471,37 @@ def st_simplify(geom, tolerance: float):
     return like_input(_host.simplify(col, float(tolerance)), fmt)
 
 
-def st_intersection(geom_a, geom_b):
+def _clipper(backend: str | None):
+    """Boolean-op engine for a backend name: the Martinez sweep by
+    default, the independent C++ edge-classification clipper under
+    ``native`` — the JTS-vs-ESRI dual-engine choice the reference makes
+    through `GeometryAPI` (`MosaicGeometryESRI.scala`)."""
+    if _resolve_backend(backend) == "native":
+        return _second
+    return _host
+
+
+def st_intersection(geom_a, geom_b, backend: str | None = None):
     """Row-wise boolean intersection (reference: ST_Intersection)."""
     a, fmt = coerce(geom_a)
-    return like_input(_host.intersection(a, to_packed(geom_b)), fmt)
+    return like_input(_clipper(backend).intersection(a, to_packed(geom_b)), fmt)
 
 
-def st_union(geom_a, geom_b):
+def st_union(geom_a, geom_b, backend: str | None = None):
     a, fmt = coerce(geom_a)
-    return like_input(_host.union(a, to_packed(geom_b)), fmt)
+    return like_input(_clipper(backend).union(a, to_packed(geom_b)), fmt)
 
 
-def st_difference(geom_a, geom_b):
+def st_difference(geom_a, geom_b, backend: str | None = None):
     a, fmt = coerce(geom_a)
-    return like_input(_host.difference(a, to_packed(geom_b)), fmt)
+    return like_input(_clipper(backend).difference(a, to_packed(geom_b)), fmt)
 
 
-def st_symdifference(geom_a, geom_b):
+def st_symdifference(geom_a, geom_b, backend: str | None = None):
     a, fmt = coerce(geom_a)
-    return like_input(_host.sym_difference(a, to_packed(geom_b)), fmt)
+    return like_input(
+        _clipper(backend).sym_difference(a, to_packed(geom_b)), fmt
+    )
 
 
 def st_unaryunion(geom):

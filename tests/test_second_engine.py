@@ -292,3 +292,15 @@ def test_clip_functions_backend_consistency():
     ai = float(np.asarray(F.st_area(F.st_intersection(a, b)))[0])
     si = float(oracle.area(second.intersection(a, b))[0])
     assert abs(ai - si) < 1e-9
+
+
+def test_boolean_ops_native_backend_selection(zones):
+    # the functions layer routes boolean ops through the independent
+    # clipper under backend="native" (the reference's GeometryAPI choice)
+    a = zones.slice(0, 3)
+    b = F.st_translate(zones.slice(0, 3), 0.004, 0.004)
+    for fn in (F.st_intersection, F.st_union, F.st_difference,
+               F.st_symdifference):
+        d = np.asarray(F.st_area(fn(a, b)))
+        n = np.asarray(F.st_area(fn(a, b, backend="native")))
+        np.testing.assert_allclose(n, d, rtol=1e-8, atol=1e-12)
