@@ -443,6 +443,127 @@ def laea_inverse(p, en, xp=np):
     return xp.stack([lon, lat], axis=-1)
 
 
+def _sterea_consts(p):
+    """Oblique-stereographic constants (EPSG Guidance Note 7-2, 'Oblique
+    Stereographic' — the double projection onto the conformal sphere)."""
+    a, e, lat0, lon0, k0, fe, fn = p
+    e2 = e * e
+    s0, c0 = math.sin(lat0), math.cos(lat0)
+    rho0 = a * (1 - e2) / (1 - e2 * s0 * s0) ** 1.5
+    nu0 = a / math.sqrt(1 - e2 * s0 * s0)
+    R = math.sqrt(rho0 * nu0)
+    n = math.sqrt(1 + e2 * c0**4 / (1 - e2))
+    S1 = (1 + s0) / (1 - s0)
+    S2 = (1 - e * s0) / (1 + e * s0)
+    w1 = (S1 * S2**e) ** n
+    sin_chi0 = (w1 - 1) / (w1 + 1)
+    c = (n + s0) * (1 - sin_chi0) / ((n - s0) * (1 + sin_chi0))
+    w2 = c * w1
+    chi0 = math.asin((w2 - 1) / (w2 + 1))
+    return R, n, c, chi0
+
+
+def sterea_forward(p, lonlat, xp=np):
+    """Oblique (non-polar) stereographic, EPSG method 9809 (Dutch RD)."""
+    a, e, lat0, lon0, k0, fe, fn = p
+    R, n, c, chi0 = _sterea_consts(p)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    s = xp.sin(lat)
+    Sa = (1 + s) / (1 - s)
+    Sb = (1 - e * s) / (1 + e * s)
+    w = c * (Sa * Sb**e) ** n
+    chi = xp.arcsin((w - 1) / (w + 1))
+    dl = n * (lon - lon0)
+    B = 1 + xp.sin(chi) * np.sin(chi0) + xp.cos(chi) * np.cos(chi0) * xp.cos(dl)
+    x = fe + 2 * R * k0 * xp.cos(chi) * xp.sin(dl) / B
+    y = fn + 2 * R * k0 * (
+        xp.sin(chi) * np.cos(chi0) - xp.cos(chi) * np.sin(chi0) * xp.cos(dl)
+    ) / B
+    return xp.stack([x, y], axis=-1)
+
+
+def sterea_inverse(p, en, xp=np, iters: int = 8):
+    a, e, lat0, lon0, k0, fe, fn = p
+    R, n, c, chi0 = _sterea_consts(p)
+    g = 2 * R * k0 * math.tan(np.pi / 4 - chi0 / 2)
+    h = 4 * R * k0 * math.tan(chi0) + g
+    x = en[..., 0] - fe
+    y = en[..., 1] - fn
+    i = xp.arctan2(x, h + y)
+    j = xp.arctan2(x, g - y) - i
+    chi = chi0 + 2 * xp.arctan((y - x * xp.tan(j / 2)) / (2 * R * k0))
+    dl = (j + 2 * i) / n
+    # conformal -> geodetic latitude via the shared isometric-latitude
+    # inversion (exp(-psi) is exactly Snyder's ts)
+    psi = 0.5 * xp.log((1 + xp.sin(chi)) / (c * (1 - xp.sin(chi)))) / n
+    lat = _phi_from_ts(xp.exp(-psi), e, xp, iters=iters)
+    return xp.stack([dl + lon0, lat], axis=-1)
+
+
+def _somerc_consts(p):
+    """Swiss oblique Mercator constants (swisstopo formulas: double
+    projection sphere + 90-degree azimuth oblique Mercator)."""
+    a, e, lat0, lon0, k0, fe, fn = p
+    e2 = e * e
+    s0, c0 = math.sin(lat0), math.cos(lat0)
+    alpha = math.sqrt(1 + e2 / (1 - e2) * c0**4)
+    R = k0 * a * math.sqrt(1 - e2) / (1 - e2 * s0 * s0)
+    b0 = math.asin(s0 / alpha)
+    K = (
+        math.log(math.tan(np.pi / 4 + b0 / 2))
+        - alpha * math.log(math.tan(np.pi / 4 + lat0 / 2))
+        + alpha * e / 2 * math.log((1 + e * s0) / (1 - e * s0))
+    )
+    return alpha, R, b0, K
+
+
+def somerc_forward(p, lonlat, xp=np):
+    """Swiss Oblique Mercator, EPSG method 9815 special case (CH1903)."""
+    a, e, lat0, lon0, k0, fe, fn = p
+    alpha, R, b0, K = _somerc_consts(p)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    s = e * xp.sin(lat)
+    S = (
+        alpha * xp.log(xp.tan(np.pi / 4 + lat / 2))
+        - alpha * e / 2 * xp.log((1 + s) / (1 - s))
+        + K
+    )
+    b = 2 * (xp.arctan(xp.exp(S)) - np.pi / 4)
+    dl = alpha * (lon - lon0)
+    # rotate to the pseudo-equator system
+    bbar = xp.arcsin(
+        np.cos(b0) * xp.sin(b) - np.sin(b0) * xp.cos(b) * xp.cos(dl)
+    )
+    lbar = xp.arctan2(
+        xp.cos(b) * xp.sin(dl),
+        np.cos(b0) * xp.cos(b) * xp.cos(dl) + np.sin(b0) * xp.sin(b),
+    )
+    x = fe + R * lbar
+    y = fn + R * xp.log(xp.tan(np.pi / 4 + bbar / 2))
+    return xp.stack([x, y], axis=-1)
+
+
+def somerc_inverse(p, en, xp=np, iters: int = 8):
+    a, e, lat0, lon0, k0, fe, fn = p
+    alpha, R, b0, K = _somerc_consts(p)
+    lbar = (en[..., 0] - fe) / R
+    bbar = 2 * (xp.arctan(xp.exp((en[..., 1] - fn) / R)) - np.pi / 4)
+    b = xp.arcsin(
+        np.cos(b0) * xp.sin(bbar) + np.sin(b0) * xp.cos(bbar) * xp.cos(lbar)
+    )
+    dl = xp.arctan2(
+        xp.cos(bbar) * xp.sin(lbar),
+        np.cos(b0) * xp.cos(bbar) * xp.cos(lbar) - np.sin(b0) * xp.sin(bbar),
+    )
+    lon = lon0 + dl / alpha
+    # geodetic latitude from the sphere latitude via the shared
+    # isometric-latitude inversion: q = (ln tan(pi/4 + b/2) - K) / alpha
+    # and ts = exp(-q)
+    q = (xp.log(xp.tan(np.pi / 4 + b / 2)) - K) / alpha
+    lat = _phi_from_ts(xp.exp(-q), e, xp, iters=iters)
+    return xp.stack([lon, lat], axis=-1)
+
+
 def merc_forward(p, lonlat, xp=np):
     """Mercator (Snyder 7), ellipsoidal; spherical falls out at e = 0."""
     a, e, k0, lon0, fe, fn = p
@@ -806,6 +927,8 @@ _FAMILY_FNS = {
     "albers": (albers_forward, albers_inverse),
     "laea": (laea_forward, laea_inverse),
     "stere_polar": (stere_polar_forward, stere_polar_inverse),
+    "sterea": (sterea_forward, sterea_inverse),
+    "somerc": (somerc_forward, somerc_inverse),
     "merc": (merc_forward, merc_inverse),
 }
 

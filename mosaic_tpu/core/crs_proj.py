@@ -39,8 +39,12 @@ from .crs import (
     albers_inverse,
     merc_forward,
     merc_inverse,
+    somerc_forward,
+    somerc_inverse,
     stere_polar_forward,
     stere_polar_inverse,
+    sterea_forward,
+    sterea_inverse,
     tm_forward,
     tm_inverse,
 )
@@ -86,7 +90,8 @@ UNITS: dict[str, float] = {
 }
 
 _SUPPORTED_PROJ = (
-    "utm, tmerc, merc, lcc, aea, laea, stere (polar), longlat/latlong"
+    "utm, tmerc, merc, lcc, aea, laea, stere (polar), sterea, somerc, "
+    "longlat/latlong"
 )
 
 
@@ -95,7 +100,7 @@ class ProjCRS:
     """One parsed CRS: projection family + ellipsoid + datum + units."""
 
     kind: str  # "tm" | "lcc2sp" | "albers" | "laea" | "stere_polar"
-    #          | "merc" | "longlat"
+    #          | "sterea" | "somerc" | "merc" | "longlat"
     params: object  # TMParams or the family's parameter tuple (None: longlat)
     a: float
     e2: float
@@ -181,7 +186,7 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
     """Parse a PROJ.4 string into a :class:`ProjCRS`.
 
     Supported projections: {supported}. Raises ``ValueError`` with the
-    supported list for anything else (krovak, somerc, poly, ...).
+    supported list for anything else (krovak, poly, ...).
     """
     kv = _parse_tokens(s)
     proj = kv.get("proj")
@@ -258,11 +263,17 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
         return ProjCRS(
             "laea", (a, e, lat0, lon0, fe, fn), a, e2, shift, to_meter, area
         )
+    if proj == "sterea":
+        p = (a, e, lat0, lon0, k0 if k0 is not None else 1.0, fe, fn)
+        return ProjCRS("sterea", p, a, e2, shift, to_meter, area)
+    if proj == "somerc":
+        p = (a, e, lat0, lon0, k0 if k0 is not None else 1.0, fe, fn)
+        return ProjCRS("somerc", p, a, e2, shift, to_meter, area)
     if proj == "stere":
         if abs(abs(math.degrees(lat0)) - 90.0) > 1e-9:
             raise ValueError(
                 "only polar +proj=stere (+lat_0=+-90) is implemented; "
-                "oblique stereographic (sterea) is not"
+                "use +proj=sterea for the oblique (double) stereographic"
             )
         south = lat0 < 0
         lat_ts = _f(kv, "lat_ts")
@@ -284,6 +295,8 @@ _FWD = {
     "albers": albers_forward,
     "laea": laea_forward,
     "stere_polar": stere_polar_forward,
+    "sterea": sterea_forward,
+    "somerc": somerc_forward,
     "merc": merc_forward,
 }
 _INV = {
@@ -292,6 +305,8 @@ _INV = {
     "albers": albers_inverse,
     "laea": laea_inverse,
     "stere_polar": stere_polar_inverse,
+    "sterea": sterea_inverse,
+    "somerc": somerc_inverse,
     "merc": merc_inverse,
 }
 
@@ -362,9 +377,21 @@ def default_area(crs: ProjCRS) -> tuple[float, float, float, float]:
             max(lon0 - 90.0, -180.0), max(lat0 - 45.0, -90.0),
             min(lon0 + 90.0, 180.0), min(lat0 + 45.0, 90.0),
         )
-    # stere_polar
-    south = crs.params[2]
-    return (-180.0, -90.0, 180.0, -60.0) if south else (-180.0, 60.0, 180.0, 90.0)
+    if crs.kind in ("sterea", "somerc"):
+        _, _, lat0, lon0, _, _, _ = crs.params
+        lat0, lon0 = math.degrees(lat0), math.degrees(lon0)
+        return (
+            max(lon0 - 10.0, -180.0), max(lat0 - 8.0, -89.0),
+            min(lon0 + 10.0, 180.0), min(lat0 + 8.0, 89.0),
+        )
+    if crs.kind == "stere_polar":
+        south = crs.params[2]
+        return (
+            (-180.0, -90.0, 180.0, -60.0)
+            if south
+            else (-180.0, 60.0, 180.0, 90.0)
+        )
+    raise ValueError(f"no default area for projection kind {crs.kind!r}")
 
 
 # --------------------------------------------------------------------------
@@ -464,6 +491,27 @@ _EPSG: dict[int, tuple[str, tuple[float, float, float, float]]] = {
     3395: (
         "+proj=merc +lon_0=0 +k=1 +x_0=0 +y_0=0 +ellps=WGS84",
         (-180.0, -80.0, 180.0, 84.0),
+    ),
+    # Amersfoort / RD New (Netherlands, oblique stereographic)
+    28992: (
+        "+proj=sterea +lat_0=52.15616055555555 +lon_0=5.38763888888889 "
+        "+k=0.9999079 +x_0=155000 +y_0=463000 "
+        "+towgs84=565.417,50.3319,465.552,-0.398957,0.343988,-1.8774,4.0725 "
+        "+ellps=bessel",
+        (3.37, 50.75, 7.21, 53.47),
+    ),
+    # CH1903 / LV03 and CH1903+ / LV95 (Swiss oblique Mercator)
+    21781: (
+        "+proj=somerc +lat_0=46.952405555555565 +lon_0=7.439583333333333 "
+        "+k_0=1 +x_0=600000 +y_0=200000 "
+        "+towgs84=674.374,15.056,405.346 +ellps=bessel",
+        (5.97, 45.83, 10.49, 47.81),
+    ),
+    2056: (
+        "+proj=somerc +lat_0=46.952405555555565 +lon_0=7.439583333333333 "
+        "+k_0=1 +x_0=2600000 +y_0=1200000 "
+        "+towgs84=674.374,15.056,405.346 +ellps=bessel",
+        (5.97, 45.83, 10.49, 47.81),
     ),
     # geographic CRSs on non-WGS84 datums
     4277: ("+proj=longlat +datum=OSGB36", (-9.0, 49.75, 2.01, 61.01)),

@@ -29,11 +29,13 @@ _CSV_ROWS = {
     26985: (180946.6307, 25647.7745, 577713.4801, 230853.3514),
     31370: (17736.0314, 23697.0977, 297289.9391, 245375.4223),
     31466: (2490547.1867, 5440321.7879, 2609576.6008, 5958700.0208),
+    28992: (12628.0541, 308179.0423, 283594.4779, 611063.1429),
+    2056: (2485869.5728, 1076443.1884, 2837076.5648, 1299941.7864),
     32198: (-886251.0296, 180252.9126, 897177.3418, 2106143.8139),
     32118: (277102.1637, 33718.9600, 490794.6230, 129387.2653),
 }
 
-_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [28355, 31983, 7855, 31970, 3395, 3435]
+_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [28355, 31983, 7855, 31970, 3395, 3435, 21781]
 
 
 def _interior_grid(srid, n=7, margin=0.25):
@@ -47,7 +49,9 @@ def _interior_grid(srid, n=7, margin=0.25):
 def test_roundtrip_below_microdegree(srid):
     ll = _interior_grid(srid)
     rt = crs.to_wgs84(crs.from_wgs84(ll, srid), srid)
-    assert np.abs(rt - ll).max() < 1e-6
+    # 5e-7 deg ~ 5 cm: headroom over the sign-flip Helmert inverse
+    # approximation for codes with larger datum parameters
+    assert np.abs(rt - ll).max() < 5e-7
     assert crs.supported(srid)
 
 
@@ -171,6 +175,78 @@ def test_register_crs_overrides_builtin_codes():
         del crs_proj._REGISTERED[32633]
         crs._PROJ_BOUNDS_CACHE.pop(32633, None)
     assert np.allclose(crs.from_wgs84(ll, 32633), builtin)
+
+
+def test_oblique_stereographic_epsg_worked_example():
+    """EPSG Guidance Note 7-2, Oblique Stereographic (Amersfoort / RD
+    New) worked example: 53N 6E (Bessel) -> E 196105.283, N 557057.739.
+
+    Projection-only (the guidance example is on the source datum), so the
+    family forward is called directly with the parsed parameters."""
+    from mosaic_tpu.core.crs import _FAMILY_FNS
+    from mosaic_tpu.core.crs_proj import lookup
+
+    rd = lookup(28992)
+    en = _FAMILY_FNS["sterea"][0](rd.params, np.radians([[6.0, 53.0]]))
+    np.testing.assert_allclose(
+        en, [[196105.283, 557057.739]], atol=2e-3
+    )
+
+
+def test_rd_datum_point_end_to_end():
+    """The Amersfoort fundamental point in ETRS89/WGS84 coordinates must
+    land on the RD false origin (E 155000, N 463000) through the full
+    chain incl. the 7-parameter Bessel datum shift — this catches
+    arc-second/microradian rotation-unit mixups that the self-inverse
+    round-trip test cannot see."""
+    en = crs.from_wgs84(np.array([[5.3872035, 52.1551744]]), 28992)
+    np.testing.assert_allclose(en, [[155000.0, 463000.0]], atol=0.5)
+
+
+def test_swiss_oblique_mercator_origin_and_conformality():
+    from mosaic_tpu.core.crs import _FAMILY_FNS
+    from mosaic_tpu.core.crs_proj import lookup
+
+    sw = lookup(21781)
+    # Bern (the projection origin) maps exactly to the false origin
+    en = _FAMILY_FNS["somerc"][0](
+        sw.params,
+        np.radians([[7.439583333333333, 46.952405555555565]]),
+    )
+    np.testing.assert_allclose(en, [[600000.0, 200000.0]], atol=1e-6)
+    # LV95 is LV03 shifted by exactly (+2_000_000, +1_000_000)
+    ll = np.array([[8.54, 47.38], [6.63, 46.52]])  # Zurich, Lausanne
+    e03 = crs.from_wgs84(ll, 21781)
+    e95 = crs.from_wgs84(ll, 2056)
+    np.testing.assert_allclose(e95 - e03, [[2e6, 1e6]] * 2, atol=1e-6)
+
+
+@pytest.mark.parametrize("srid", [28992, 21781])
+def test_oblique_projections_are_conformal(srid):
+    """A conformal projection's Jacobian (in ellipsoidal-metric terms:
+    east = nu cos(lat) dlon, north = rho dlat) is a scaled rotation —
+    a strong whole-formula property check."""
+    import math
+
+    p = np.array([[6.3, 52.2]]) if srid == 28992 else np.array([[8.5, 46.8]])
+    h = 1e-6
+    J = np.zeros((2, 2))
+    for k in range(2):
+        dp = np.zeros((1, 2))
+        dp[0, k] = h
+        J[:, k] = (crs.from_wgs84(p + dp, srid) - crs.from_wgs84(p - dp, srid))[
+            0
+        ] / (2 * h)
+    lat = math.radians(p[0, 1])
+    a, f = 6377397.155, 1 / 299.1528128  # Bessel (both codes)
+    e2 = f * (2 - f)
+    s = math.sin(lat)
+    nu = a / math.sqrt(1 - e2 * s * s)
+    rho = a * (1 - e2) / (1 - e2 * s * s) ** 1.5
+    J[:, 0] /= nu * math.cos(lat)  # per-meter east on the ellipsoid
+    J[:, 1] /= rho  # per-meter north
+    resid = (abs(J[0, 0] - J[1, 1]) + abs(J[0, 1] + J[1, 0])) / np.abs(J).max()
+    assert resid < 2e-4, (J, resid)
 
 
 def test_parse_errors_are_loud():
