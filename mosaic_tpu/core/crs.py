@@ -468,10 +468,9 @@ def sterea_forward(p, lonlat, xp=np):
     a, e, lat0, lon0, k0, fe, fn = p
     R, n, c, chi0 = _sterea_consts(p)
     lon, lat = lonlat[..., 0], lonlat[..., 1]
-    s = xp.sin(lat)
-    Sa = (1 + s) / (1 - s)
-    Sb = (1 - e * s) / (1 + e * s)
-    w = c * (Sa * Sb**e) ** n
+    # Snyder's ts carries the whole conformal-latitude algebra:
+    # ((1+s)/(1-s)) * ((1-es)/(1+es))^e == ts(lat)^-2
+    w = c * _ts_fn(lat, e, xp) ** (-2.0 * n)
     chi = xp.arcsin((w - 1) / (w + 1))
     dl = n * (lon - lon0)
     B = 1 + xp.sin(chi) * np.sin(chi0) + xp.cos(chi) * np.cos(chi0) * xp.cos(dl)
@@ -522,12 +521,8 @@ def somerc_forward(p, lonlat, xp=np):
     a, e, lat0, lon0, k0, fe, fn = p
     alpha, R, b0, K = _somerc_consts(p)
     lon, lat = lonlat[..., 0], lonlat[..., 1]
-    s = e * xp.sin(lat)
-    S = (
-        alpha * xp.log(xp.tan(np.pi / 4 + lat / 2))
-        - alpha * e / 2 * xp.log((1 + s) / (1 - s))
-        + K
-    )
+    # isometric latitude via Snyder's ts: S = K - alpha * ln ts(lat)
+    S = K - alpha * xp.log(_ts_fn(lat, e, xp))
     b = 2 * (xp.arctan(xp.exp(S)) - np.pi / 4)
     dl = alpha * (lon - lon0)
     # rotate to the pseudo-equator system
