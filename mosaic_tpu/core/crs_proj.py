@@ -37,6 +37,8 @@ from .crs import (
     lcc2sp_inverse,
     albers_forward,
     albers_inverse,
+    krovak_forward,
+    krovak_inverse,
     merc_forward,
     merc_inverse,
     somerc_forward,
@@ -91,7 +93,7 @@ UNITS: dict[str, float] = {
 
 _SUPPORTED_PROJ = (
     "utm, tmerc, merc, lcc, aea, laea, stere (polar), sterea, somerc, "
-    "longlat/latlong"
+    "krovak, longlat/latlong"
 )
 
 
@@ -100,7 +102,7 @@ class ProjCRS:
     """One parsed CRS: projection family + ellipsoid + datum + units."""
 
     kind: str  # "tm" | "lcc2sp" | "albers" | "laea" | "stere_polar"
-    #          | "sterea" | "somerc" | "merc" | "longlat"
+    #          | "sterea" | "somerc" | "krovak" | "merc" | "longlat"
     params: object  # TMParams or the family's parameter tuple (None: longlat)
     a: float
     e2: float
@@ -186,7 +188,7 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
     """Parse a PROJ.4 string into a :class:`ProjCRS`.
 
     Supported projections: {supported}. Raises ``ValueError`` with the
-    supported list for anything else (krovak, poly, ...).
+    supported list for anything else (poly, eqdc, ...).
     """
     kv = _parse_tokens(s)
     proj = kv.get("proj")
@@ -263,6 +265,20 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
         return ProjCRS(
             "laea", (a, e, lat0, lon0, fe, fn), a, e2, shift, to_meter, area
         )
+    if proj == "krovak":
+        # defaults are the S-JTSK definition (EPSG 9819); +alpha is the
+        # cone-axis azimuth, the 78.5 deg pseudo standard parallel is
+        # fixed unless +lat_1 overrides it
+        alpha = _R(_f(kv, "alpha", 30.28813972222222))
+        phi1 = _R(_f(kv, "lat_1", 78.5))
+        lat0 = _R(_f(kv, "lat_0", 49.5))
+        # PROJ's krovak lon_0 default is 24d50'E (S-JTSK), not Greenwich
+        klon0 = _R(_f(kv, "lon_0", 24.833333333333332))
+        p = (
+            a, e, lat0, klon0, alpha, phi1,
+            k0 if k0 is not None else 0.9999, fe, fn,
+        )
+        return ProjCRS("krovak", p, a, e2, shift, to_meter, area)
     if proj == "sterea":
         p = (a, e, lat0, lon0, k0 if k0 is not None else 1.0, fe, fn)
         return ProjCRS("sterea", p, a, e2, shift, to_meter, area)
@@ -295,6 +311,7 @@ _FWD = {
     "albers": albers_forward,
     "laea": laea_forward,
     "stere_polar": stere_polar_forward,
+    "krovak": krovak_forward,
     "sterea": sterea_forward,
     "somerc": somerc_forward,
     "merc": merc_forward,
@@ -305,6 +322,7 @@ _INV = {
     "albers": albers_inverse,
     "laea": laea_inverse,
     "stere_polar": stere_polar_inverse,
+    "krovak": krovak_inverse,
     "sterea": sterea_inverse,
     "somerc": somerc_inverse,
     "merc": merc_inverse,
@@ -377,6 +395,8 @@ def default_area(crs: ProjCRS) -> tuple[float, float, float, float]:
             max(lon0 - 90.0, -180.0), max(lat0 - 45.0, -90.0),
             min(lon0 + 90.0, 180.0), min(lat0 + 45.0, 90.0),
         )
+    if crs.kind == "krovak":
+        return (12.0, 47.7, 22.6, 51.1)  # S-JTSK area of use
     if crs.kind in ("sterea", "somerc"):
         _, _, lat0, lon0, _, _, _ = crs.params
         lat0, lon0 = math.degrees(lat0), math.degrees(lon0)
@@ -492,6 +512,14 @@ _EPSG: dict[int, tuple[str, tuple[float, float, float, float]]] = {
         "+proj=merc +lon_0=0 +k=1 +x_0=0 +y_0=0 +ellps=WGS84",
         (-180.0, -80.0, 180.0, 84.0),
     ),
+    # S-JTSK / Krovak (Czechia + Slovakia): 5514 Greenwich-referenced,
+    # 2065 the Ferro-referenced original (same projection, same axes here)
+    5514: (
+        "+proj=krovak +lat_0=49.5 +lon_0=24.83333333333333 "
+        "+alpha=30.28813972222222 +k=0.9999 +x_0=0 +y_0=0 "
+        "+towgs84=589,76,480 +ellps=bessel",
+        (12.09, 47.74, 22.56, 51.05),
+    ),
     # Amersfoort / RD New (Netherlands, oblique stereographic)
     28992: (
         "+proj=sterea +lat_0=52.15616055555555 +lon_0=5.38763888888889 "
@@ -563,6 +591,9 @@ for _z in range(17, 26):
         f"+proj=utm +zone={_z} +south " + _GRS,
         (_z * 6 - 186.0, -35.0, _z * 6 - 180.0, 5.0),
     )
+
+# the Ferro-referenced original S-JTSK code shares 5514's definition
+_EPSG[2065] = _EPSG[5514]
 
 _PARSED: dict[int, ProjCRS] = {}
 _REGISTERED: dict[int, ProjCRS] = {}

@@ -30,12 +30,13 @@ _CSV_ROWS = {
     31370: (17736.0314, 23697.0977, 297289.9391, 245375.4223),
     31466: (2490547.1867, 5440321.7879, 2609576.6008, 5958700.0208),
     28992: (12628.0541, 308179.0423, 283594.4779, 611063.1429),
+    2065: (-951370.4446, -1352211.7003, -159556.3438, -912234.3486),
     2056: (2485869.5728, 1076443.1884, 2837076.5648, 1299941.7864),
     32198: (-886251.0296, 180252.9126, 897177.3418, 2106143.8139),
     32118: (277102.1637, 33718.9600, 490794.6230, 129387.2653),
 }
 
-_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [28355, 31983, 7855, 31970, 3395, 3435, 21781]
+_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [28355, 31983, 7855, 31970, 3395, 3435, 21781, 5514]
 
 
 def _interior_grid(srid, n=7, margin=0.25):
@@ -203,6 +204,22 @@ def test_rd_datum_point_end_to_end():
     np.testing.assert_allclose(en, [[155000.0, 463000.0]], atol=0.5)
 
 
+def test_krovak_epsg_worked_example():
+    """EPSG Guidance Note 7-2, Krovak worked example: 50d12'32.442"N
+    16d50'59.179"E (Bessel) -> southing 1050538.643, westing 568991.017
+    (proj axis convention negates both)."""
+    from mosaic_tpu.core.crs import _FAMILY_FNS
+    from mosaic_tpu.core.crs_proj import lookup
+
+    kr = lookup(5514)
+    ll = np.radians([[16 + 50 / 60 + 59.179 / 3600,
+                      50 + 12 / 60 + 32.442 / 3600]])
+    en = _FAMILY_FNS["krovak"][0](kr.params, ll)
+    np.testing.assert_allclose(
+        en, [[-568991.017, -1050538.643]], atol=0.05
+    )
+
+
 def test_swiss_oblique_mercator_origin_and_conformality():
     from mosaic_tpu.core.crs import _FAMILY_FNS
     from mosaic_tpu.core.crs_proj import lookup
@@ -251,7 +268,7 @@ def test_oblique_projections_are_conformal(srid):
 
 def test_parse_errors_are_loud():
     with pytest.raises(ValueError, match="implemented families"):
-        parse_proj("+proj=krovak +ellps=bessel")
+        parse_proj("+proj=poly +ellps=clrk66")
     with pytest.raises(ValueError, match="prime meridian"):
         parse_proj("+proj=lcc +lat_1=49 +lat_2=44 +pm=paris")
     with pytest.raises(ValueError, match="towgs84"):

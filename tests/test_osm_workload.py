@@ -96,6 +96,7 @@ def test_osm_profile_structure(table):
     else:
         GOLDEN.parent.mkdir(exist_ok=True)
         GOLDEN.write_text(json.dumps(dig, indent=1, sort_keys=True))
+        pytest.skip("golden created; rerun to compare")
 
 
 def test_osm_profile_join_roundtrip(table):
