@@ -639,6 +639,87 @@ def krovak_inverse(p, en, xp=np, iters: int = 8):
     return xp.stack([lon, lat], axis=-1)
 
 
+def _poly_arc_params(a, e):
+    """TMParams shim reusing the meridian-arc series at scale 1."""
+    e2 = e * e
+    b = a * math.sqrt(1 - e2)
+    return TMParams(a=a, b=b, f0=1.0, lat0=0.0, lon0=0.0, e0=0.0, n0=0.0)
+
+
+def poly_forward(p, lonlat, xp=np):
+    """American Polyconic (Snyder 18, ellipsoidal). Every parallel is an
+    arc of true scale; the central meridian is true length."""
+    a, e, lat0, lon0, fe, fn = p
+    e2 = e * e
+    tmp = _poly_arc_params(a, e)
+    M0 = _tm_meridional_arc(tmp, np.asarray(lat0), np)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    # guard the equator (cot(0) singularity): the series limit is the
+    # equirectangular x = a*dl, y = -M0
+    tiny = xp.abs(lat) < 1e-12
+    lat_s = xp.where(tiny, 1e-12, lat)
+    ss = xp.sin(lat_s)
+    N = a / xp.sqrt(1 - e2 * ss * ss)
+    E = (lon - lon0) * ss
+    cot = xp.cos(lat_s) / ss
+    M = _tm_meridional_arc(tmp, lat_s, xp)
+    x = xp.where(tiny, a * (lon - lon0), N * cot * xp.sin(E))
+    y = xp.where(tiny, -M0, M - M0 + N * cot * (1 - xp.cos(E)))
+    return xp.stack([fe + x, fn + y], axis=-1)
+
+
+def poly_inverse(p, en, xp=np, iters: int = 12):
+    """Inverse by damped 2-D Newton on the forward with a numerical
+    Jacobian — fixed iteration count (jit-safe), immune to the
+    transcription hazards of Snyder's 18-21 series."""
+    a, e, lat0, lon0, fe, fn = p
+    x = en[..., 0] - fe
+    y = en[..., 1] - fn
+    # initial guess: equirectangular-ish
+    lat = lat0 + y / a
+    lon = lon0 + x / (a * xp.maximum(xp.cos(lat), 0.1))
+    # dtype-aware step: sqrt(eps) of the working precision (an absolute
+    # 1e-7 step under float32 would amplify output quantization into a
+    # garbage Jacobian)
+    h = float(np.sqrt(np.finfo(np.asarray(en).dtype).eps)) * 0.1
+    cap = 0.3  # damping: cap the step (radians) so far-field points
+    #            walk toward the solution instead of overshooting
+    for _ in range(iters):
+        ll = xp.stack([lon, lat], axis=-1)
+        f0_ = poly_forward(p, ll, xp)
+        fx = poly_forward(p, ll + np.array([h, 0.0]), xp)
+        fy = poly_forward(p, ll + np.array([0.0, h]), xp)
+        j00 = (fx[..., 0] - f0_[..., 0]) / h
+        j10 = (fx[..., 1] - f0_[..., 1]) / h
+        j01 = (fy[..., 0] - f0_[..., 0]) / h
+        j11 = (fy[..., 1] - f0_[..., 1]) / h
+        det = j00 * j11 - j01 * j10
+        det = xp.where(xp.abs(det) < 1e-30, 1e-30, det)
+        rx = en[..., 0] - f0_[..., 0]
+        ry = en[..., 1] - f0_[..., 1]
+        dlon = (j11 * rx - j01 * ry) / det
+        dlat = (-j10 * rx + j00 * ry) / det
+        dlon = xp.clip(dlon, -cap, cap)
+        dlat = xp.clip(dlat, -cap, cap)
+        lon = xp.clip(lon + dlon, lon0 - np.pi, lon0 + np.pi)
+        lat = xp.clip(lat + dlat, -1.5707, 1.5707)
+    # far outside the usable domain the polyconic wraps parallels into
+    # full circles and inversion is ill-posed — flag non-converged points
+    # as NaN instead of returning a plausible-looking wrong coordinate
+    res = poly_forward(p, xp.stack([lon, lat], axis=-1), xp)
+    bad = (
+        xp.abs(res[..., 0] - en[..., 0]) + xp.abs(res[..., 1] - en[..., 1])
+    ) > 1e-3 * a / 6.4e6
+    # the forward is non-injective once a parallel wraps its full circle
+    # (|dl sin(lat)| >= pi): a residual-clean answer there may be a
+    # different pre-image of the same point — refuse it too
+    bad = bad | (xp.abs((lon - lon0) * xp.sin(lat)) >= np.pi)
+    nan = xp.asarray(np.nan, dtype=res.dtype) if xp is not np else np.nan
+    lon = xp.where(bad, nan, lon)
+    lat = xp.where(bad, nan, lat)
+    return xp.stack([lon, lat], axis=-1)
+
+
 def merc_forward(p, lonlat, xp=np):
     """Mercator (Snyder 7), ellipsoidal; spherical falls out at e = 0."""
     a, e, k0, lon0, fe, fn = p
@@ -1005,6 +1086,7 @@ _FAMILY_FNS = {
     "sterea": (sterea_forward, sterea_inverse),
     "somerc": (somerc_forward, somerc_inverse),
     "krovak": (krovak_forward, krovak_inverse),
+    "poly": (poly_forward, poly_inverse),
     "merc": (merc_forward, merc_inverse),
 }
 

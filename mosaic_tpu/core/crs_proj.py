@@ -39,6 +39,8 @@ from .crs import (
     albers_inverse,
     krovak_forward,
     krovak_inverse,
+    poly_forward,
+    poly_inverse,
     merc_forward,
     merc_inverse,
     somerc_forward,
@@ -93,7 +95,7 @@ UNITS: dict[str, float] = {
 
 _SUPPORTED_PROJ = (
     "utm, tmerc, merc, lcc, aea, laea, stere (polar), sterea, somerc, "
-    "krovak, longlat/latlong"
+    "krovak, poly, longlat/latlong"
 )
 
 
@@ -188,7 +190,7 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
     """Parse a PROJ.4 string into a :class:`ProjCRS`.
 
     Supported projections: {supported}. Raises ``ValueError`` with the
-    supported list for anything else (poly, eqdc, ...).
+    supported list for anything else (eqdc, cass, ...).
     """
     kv = _parse_tokens(s)
     proj = kv.get("proj")
@@ -265,6 +267,9 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
         return ProjCRS(
             "laea", (a, e, lat0, lon0, fe, fn), a, e2, shift, to_meter, area
         )
+    if proj == "poly":
+        p = (a, e, lat0, lon0, fe, fn)
+        return ProjCRS("poly", p, a, e2, shift, to_meter, area)
     if proj == "krovak":
         # defaults are the S-JTSK definition (EPSG 9819); +alpha is the
         # cone-axis azimuth, the 78.5 deg pseudo standard parallel is
@@ -312,6 +317,7 @@ _FWD = {
     "laea": laea_forward,
     "stere_polar": stere_polar_forward,
     "krovak": krovak_forward,
+    "poly": poly_forward,
     "sterea": sterea_forward,
     "somerc": somerc_forward,
     "merc": merc_forward,
@@ -323,6 +329,7 @@ _INV = {
     "laea": laea_inverse,
     "stere_polar": stere_polar_inverse,
     "krovak": krovak_inverse,
+    "poly": poly_inverse,
     "sterea": sterea_inverse,
     "somerc": somerc_inverse,
     "merc": merc_inverse,
@@ -394,6 +401,13 @@ def default_area(crs: ProjCRS) -> tuple[float, float, float, float]:
         return (
             max(lon0 - 90.0, -180.0), max(lat0 - 45.0, -90.0),
             min(lon0 + 90.0, 180.0), min(lat0 + 45.0, 90.0),
+        )
+    if crs.kind == "poly":
+        _, _, lat0, lon0, _, _ = crs.params
+        lat0, lon0 = math.degrees(lat0), math.degrees(lon0)
+        return (
+            max(lon0 - 30.0, -180.0), max(lat0 - 30.0, -89.0),
+            min(lon0 + 30.0, 180.0), min(lat0 + 30.0, 89.0),
         )
     if crs.kind == "krovak":
         return (12.0, 47.7, 22.6, 51.1)  # S-JTSK area of use
@@ -511,6 +525,18 @@ _EPSG: dict[int, tuple[str, tuple[float, float, float, float]]] = {
     3395: (
         "+proj=merc +lon_0=0 +k=1 +x_0=0 +y_0=0 +ellps=WGS84",
         (-180.0, -80.0, 180.0, 84.0),
+    ),
+    # SAD69 / Brazil Polyconic (GRS67 "aust_SA" ellipsoid)
+    29101: (
+        "+proj=poly +lat_0=0 +lon_0=-54 +x_0=5000000 +y_0=10000000 "
+        "+towgs84=-57,1,-41 +ellps=aust_SA",
+        (-74.05, -35.89, -26.12, 7.25),
+    ),
+    # SIRGAS 2000 / Brazil Polyconic (same projection, GRS80, null shift)
+    5880: (
+        "+proj=poly +lat_0=0 +lon_0=-54 +x_0=5000000 +y_0=10000000 "
+        + _GRS,
+        (-74.05, -35.89, -26.12, 7.25),
     ),
     # S-JTSK / Krovak (Czechia + Slovakia): 5514 Greenwich-referenced,
     # 2065 the Ferro-referenced original (same projection, same axes here)

@@ -31,12 +31,13 @@ _CSV_ROWS = {
     31466: (2490547.1867, 5440321.7879, 2609576.6008, 5958700.0208),
     28992: (12628.0541, 308179.0423, 283594.4779, 611063.1429),
     2065: (-951370.4446, -1352211.7003, -159556.3438, -912234.3486),
+    29101: (2786482.4389, 5670041.9266, 8077014.5748, 10896215.6624),
     2056: (2485869.5728, 1076443.1884, 2837076.5648, 1299941.7864),
     32198: (-886251.0296, 180252.9126, 897177.3418, 2106143.8139),
     32118: (277102.1637, 33718.9600, 490794.6230, 129387.2653),
 }
 
-_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [28355, 31983, 7855, 31970, 3395, 3435, 21781, 5514]
+_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [28355, 31983, 7855, 31970, 3395, 3435, 21781, 5514, 5880]
 
 
 def _interior_grid(srid, n=7, margin=0.25):
@@ -204,6 +205,58 @@ def test_rd_datum_point_end_to_end():
     np.testing.assert_allclose(en, [[155000.0, 463000.0]], atol=0.5)
 
 
+def test_polyconic_defining_properties():
+    """American Polyconic (Snyder 18): the central meridian is true
+    length (y == meridian arc) and every parallel is an arc of true
+    scale — the projection's defining properties, checked directly."""
+    import math
+
+    from mosaic_tpu.core.crs import (
+        _FAMILY_FNS,
+        _poly_arc_params,
+        _tm_meridional_arc,
+    )
+    from mosaic_tpu.core.crs_proj import lookup
+
+    br = lookup(5880)
+    a, e = br.params[0], br.params[1]
+    tmp = _poly_arc_params(a, e)
+    fwd = _FAMILY_FNS["poly"][0]
+    for latd in (-30.0, -10.0, 5.0):
+        en = fwd(br.params, np.radians([[-54.0, latd]]))
+        M = _tm_meridional_arc(tmp, np.radians(latd), np)
+        assert abs(en[0, 0] - 5e6) < 1e-6
+        assert abs(en[0, 1] - 1e7 - M) < 1e-6
+    for latd in (-25.0, -5.0):
+        lat = math.radians(latd)
+        N = a / math.sqrt(1 - e * e * math.sin(lat) ** 2)
+        dl = math.radians(0.01)
+        p1 = fwd(br.params, np.array([[math.radians(-60.0), lat]]))
+        p2 = fwd(br.params, np.array([[math.radians(-60.0) + dl, lat]]))
+        chord = np.linalg.norm(p2 - p1)
+        assert abs(chord - N * math.cos(lat) * dl) / chord < 1e-9
+
+
+def test_polyconic_inverse_contract_far_field():
+    """Outside the usable domain the polyconic forward is non-injective;
+    the inverse must return a principal-domain pre-image (forward of the
+    result reproduces the input) or NaN — never a silent wrong answer."""
+    from mosaic_tpu.core.crs import _FAMILY_FNS
+    from mosaic_tpu.core.crs_proj import lookup
+
+    br = lookup(5880)
+    fwd, inv = _FAMILY_FNS["poly"]
+    lons = np.radians(np.linspace(-170, 170, 12))
+    lats = np.radians(np.linspace(-80, 80, 11))
+    g = np.stack(np.meshgrid(lons, lats), -1).reshape(-1, 2)
+    en = fwd(br.params, g)
+    rt = inv(br.params, en, iters=25)
+    ok = ~np.isnan(rt).any(axis=1)
+    assert ok.any()  # plenty of the plane inverts
+    back = fwd(br.params, rt[ok])
+    np.testing.assert_allclose(back, en[ok], atol=1e-3)
+
+
 def test_krovak_epsg_worked_example():
     """EPSG Guidance Note 7-2, Krovak worked example: 50d12'32.442"N
     16d50'59.179"E (Bessel) -> southing 1050538.643, westing 568991.017
@@ -268,7 +321,7 @@ def test_oblique_projections_are_conformal(srid):
 
 def test_parse_errors_are_loud():
     with pytest.raises(ValueError, match="implemented families"):
-        parse_proj("+proj=poly +ellps=clrk66")
+        parse_proj("+proj=eqdc +lat_1=20 +lat_2=60")
     with pytest.raises(ValueError, match="prime meridian"):
         parse_proj("+proj=lcc +lat_1=49 +lat_2=44 +pm=paris")
     with pytest.raises(ValueError, match="towgs84"):
