@@ -31,9 +31,11 @@ ships 3,288 static rows, `core/crs/CRSBoundsProvider.scala:70-95`).
 
 Arbitrary EPSG codes beyond the hand-registered set resolve through the
 parameter-driven constructor in `crs_proj`: a PROJ.4-string parser over
-the same projection kernels (plus general Mercator), 7-parameter Helmert
-datum shifts (``+towgs84``), unit scaling, a built-in EPSG table, and
-`register_crs` for runtime registration of any further code.
+the same projection kernels (plus general Mercator, oblique
+stereographic per the EPSG worked example, Swiss oblique Mercator,
+Krovak and American Polyconic), 7-parameter Helmert datum shifts
+(``+towgs84``), unit scaling, a built-in EPSG table, and `register_crs`
+for runtime registration of any further code.
 """
 
 from __future__ import annotations
