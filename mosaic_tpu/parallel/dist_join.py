@@ -34,12 +34,19 @@ _I64_MAX = np.iinfo(np.int64).max
 
 
 def make_mesh(
-    n_devices: int | None = None, devices=None, cell_axis: int | None = None
+    n_devices: int | None = None,
+    devices=None,
+    cell_axis: int | None = None,
+    slices: int | None = None,
 ) -> Mesh:
-    """A 2-D ``(dp, cell)`` mesh over the first ``n_devices`` devices.
+    """A ``(dp, cell)`` mesh — or ``(dcn, dp, cell)`` with ``slices`` set —
+    over the first ``n_devices`` devices.
 
-    ``dp`` × ``cell`` both shard the point axis; ``cell`` additionally shards
-    the chip index (which is all-gathered over that axis inside the step).
+    Every axis shards the point axis; ``cell`` additionally shards the chip
+    index (all-gathered over ICI inside the step). ``slices`` models
+    multi-slice topologies: the outer ``dcn`` axis maps across slices, so
+    the only cross-slice traffic is the final ``psum`` of the per-zone
+    aggregates — the index all-gather stays within each slice's ICI.
     """
     devs = list(devices if devices is not None else jax.devices())
     if n_devices is not None:
@@ -53,6 +60,16 @@ def make_mesh(
         cell_axis = 2 if n % 2 == 0 and n > 1 else 1
     if n % cell_axis:
         raise ValueError(f"{n} devices not divisible by cell_axis={cell_axis}")
+    if slices is not None:
+        rest = n // cell_axis
+        if rest % slices:
+            raise ValueError(
+                f"{rest} dp-devices not divisible by slices={slices}"
+            )
+        return Mesh(
+            np.asarray(devs).reshape(slices, rest // slices, cell_axis),
+            ("dcn", "dp", "cell"),
+        )
     return Mesh(np.asarray(devs).reshape(n // cell_axis, cell_axis), ("dp", "cell"))
 
 
@@ -207,7 +224,7 @@ def distributed_join_step(
     table_sharded = (
         table_size is not None and cell_shards > 1 and table_size % cell_shards == 0
     )
-    point_spec = P(("dp", "cell"))
+    point_spec = P(mesh.axis_names)  # every axis shards points (dcn/dp/cell)
     index_spec = _index_specs(
         P("cell"), P("cell") if table_sharded else P()
     )
@@ -221,7 +238,7 @@ def distributed_join_step(
         counts = jax.ops.segment_sum(
             jnp.ones_like(zone, dtype=jnp.int64), zone, num_segments=num_zones + 1
         )[:num_zones]
-        counts = lax.psum(counts, ("dp", "cell"))
+        counts = lax.psum(counts, mesh.axis_names)
         return match, counts
 
     sharded = jax.shard_map(
