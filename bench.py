@@ -217,7 +217,7 @@ def _maybe_late_tpu_retry(obj: dict) -> dict:
         str(r.get("outcome", "")).startswith(("hang_timeout", "error_rc", "budget"))
         for r in trail
     )
-    if not fell_back or "TPU" in str(detail.get("device", "")):
+    if not fell_back or detail.get("platform") == "tpu":
         return obj
     rec: dict = {}
     verdict = _probe_once(
@@ -339,6 +339,10 @@ def main():
 
         detail["device"] = str(jax.devices()[0])
         on_tpu = jax.devices()[0].platform not in ("cpu",)
+        # the measured platform, recorded explicitly: device strings on this
+        # rig ('axon') need not contain 'TPU', so the late-retry guard keys
+        # on this instead of a substring match
+        detail["platform"] = "tpu" if on_tpu else "cpu"
         n_device = int(
             os.environ.get(
                 "MOSAIC_BENCH_POINTS", 4_000_000 if on_tpu else 1_000_000
@@ -517,17 +521,20 @@ def main():
             return time.perf_counter() - t0, outs
 
         def measure(fc, hc):
-            times, outs0 = [], None
+            # overflow is checked on EVERY pass (each pass joins a distinct
+            # point set, so a cap overflow may appear only in a later one
+            # — the min-time pass must not be reported with invalid outputs)
+            times, outs0, n_match, n_over = [], None, 0, 0
             for p, sp in enumerate(staged_passes):
                 dt, outs = run_pass(sp, fc, hc)
                 times.append(round(dt, 4))
+                for o in outs:
+                    m, v = _stats(o)
+                    n_over += int(v)
+                    if p == 0:
+                        n_match += int(m)
                 if p == 0:
                     outs0 = outs
-            n_match = n_over = 0
-            for o in outs0:
-                m, v = _stats(o)
-                n_match += int(m)
-                n_over += int(v)
             return times, outs0, n_match, n_over
 
         times, outs0, n_match, n_over = measure(fcap, hcap)

@@ -683,7 +683,8 @@ def poly_inverse(p, en, xp=np, iters: int = 12):
     # dtype-aware step: sqrt(eps) of the working precision (an absolute
     # 1e-7 step under float32 would amplify output quantization into a
     # garbage Jacobian)
-    h = float(np.sqrt(np.finfo(np.asarray(en).dtype).eps)) * 0.1
+    # tracers expose .dtype, so no np.asarray (which would break under jit)
+    h = float(np.sqrt(np.finfo(np.dtype(en.dtype)).eps)) * 0.1
     cap = 0.3  # damping: cap the step (radians) so far-field points
     #            walk toward the solution instead of overshooting
     for _ in range(iters):

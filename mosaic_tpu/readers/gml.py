@@ -49,7 +49,22 @@ def _seg_coords(el, dim_hint: int) -> tuple[np.ndarray, np.ndarray | None]:
     pl = _find(el, "posList")
     if pl is not None:
         vals = np.asarray((pl.text or "").split(), dtype=np.float64)
-        dim = int(pl.get("srsDimension", el.get("srsDimension", dim_hint)))
+        attr = pl.get("srsDimension", el.get("srsDimension"))
+        if attr is not None:
+            dim = int(attr)
+        else:
+            # real-world GML omits srsDimension on 3-D posLists; prefer a
+            # dimension that actually divides the token count over blindly
+            # assuming the hint. Token counts divisible by both 2 and 3
+            # stay genuinely ambiguous — the hint wins those (a 3-D list
+            # with an even point count still parses as 2-D).
+            cands = [d for d in (dim_hint, 2, 3) if len(vals) % d == 0]
+            if not cands:
+                raise ValueError(
+                    f"posList has {len(vals)} values, divisible by "
+                    "neither 2 nor 3"
+                )
+            dim = cands[0]
         vals = vals.reshape(-1, dim)
         z = vals[:, 2].copy() if dim >= 3 else None
         return np.ascontiguousarray(vals[:, :2]), z

@@ -352,6 +352,23 @@ def test_proj_table_code_under_jit():
     assert np.abs(np.asarray(got) - want).max() < 1e-6
 
 
+def test_polyconic_inverse_under_jit():
+    # regression (round-4 advisor): poly_inverse materialized the tracer
+    # via np.asarray to pick its finite-difference step, so jitted
+    # to_wgs84 for polyconic codes (5880/29101) raised
+    # TracerArrayConversionError despite the 'jit-safe' docstring
+    import jax
+    import jax.numpy as jnp
+
+    ll = _interior_grid(5880, n=4)
+    en = crs.from_wgs84(ll, 5880)
+    want = crs.to_wgs84(en, 5880)
+    got = jax.jit(lambda x: crs.to_wgs84(x, 5880, xp=jnp))(
+        jnp.asarray(en)
+    )
+    assert np.abs(np.asarray(got) - want).max() < 1e-5
+
+
 def test_datum_shift_geographic_crs():
     # 4277 (OSGB36 geographic): shifting Greenwich to WGS84 moves it ~100 m
     ll_osgb = np.array([[0.0, 51.4778]])

@@ -604,3 +604,24 @@ def test_gml_edge_cases(tmp_path):
     np.testing.assert_allclose(g.geom_xy(2), [[0, 0], [1, 1], [2, 0]])
     # homogeneous point members collapse to MULTIPOINT
     assert g.geometry_type(3) == GeometryType.MULTIPOINT
+
+
+def test_gml_3d_poslist_without_srsdimension(tmp_path):
+    # real-world GML omits srsDimension on 3-D posLists; the reader must
+    # infer dim=3 when the token count divides only by 3 (9 tokens here),
+    # not silently reshape to (-1, 2)
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.gml import read_gml
+
+    doc = """<c xmlns:gml="http://www.opengis.net/gml">
+     <gml:featureMember><f><geom>
+      <gml:LineString><gml:posList>0 0 5 1 1 6 2 0 7</gml:posList></gml:LineString>
+     </geom></f></gml:featureMember>
+    </c>"""
+    p = tmp_path / "nodim3d.gml"
+    p.write_text(doc)
+    t = read_gml(p)
+    g = t.geometry
+    assert g.geometry_type(0) == GeometryType.LINESTRING
+    np.testing.assert_allclose(g.geom_xy(0), [[0, 0], [1, 1], [2, 0]])
+    assert g.has_z(0)

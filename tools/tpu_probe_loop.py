@@ -1,0 +1,138 @@
+"""Persistent TPU-tunnel probe loop for round 5.
+
+Runs forever: every cycle it launches the same subprocess probe bench.py
+uses (compile+run a tiny jitted op — devices() alone can succeed while
+compilation hangs).  Every attempt is appended to
+``TPU_PROBE_TRAIL_r05.jsonl``.  The moment a probe succeeds, it runs the
+full ``bench.py`` pinned to the TPU; a nonzero result is saved to
+``BENCH_TPU_LIVE.json`` and a timestamped copy is kept per attempt so a
+later, better number never overwrites the evidence that an earlier one
+existed.  After a success it keeps probing at a slower cadence and
+re-benches hourly so improvements made later in the round still land.
+
+Round-4 lesson (TPU_PROBE_TRAIL_r04.jsonl): single-shot probing loses
+whole rounds; the tunnel can hang for hours then recover.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAIL = os.path.join(REPO, "TPU_PROBE_TRAIL_r05.jsonl")
+LIVE = os.path.join(REPO, "BENCH_TPU_LIVE.json")
+
+#: after a good bench, re-run this often while the tunnel stays up (the
+#: code under test improves during the round)
+REBENCH_S = 3600.0
+
+_PROBE_CODE = """
+import json, sys, time
+t0 = time.time()
+import jax, jax.numpy as jnp
+devs = jax.devices()
+t1 = time.time()
+if devs[0].platform in ("cpu",):
+    print(json.dumps({"platform": "cpu", "devices_s": round(t1 - t0, 2)}))
+    sys.exit(3)
+x = jnp.arange(1024, dtype=jnp.int32)
+r = int(jax.jit(lambda v: ((v * v + 1) ^ (v >> 7)).sum())(x))
+t2 = time.time()
+print(json.dumps({
+    "platform": str(devs[0].platform), "device": str(devs[0]),
+    "devices_s": round(t1 - t0, 2), "compile_run_s": round(t2 - t1, 2),
+}))
+sys.exit(0 if r == int(((x * x + 1) ^ (x >> 7)).sum()) else 4)
+"""
+
+
+def log(rec: dict) -> None:
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    with open(TRAIL, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def probe(timeout: float = 300.0) -> dict:
+    rec: dict = {}
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_CODE],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        lines = r.stdout.strip().splitlines()
+        if lines:
+            try:
+                rec.update(json.loads(lines[-1]))
+            except ValueError:
+                rec["stdout"] = lines[-1][:160]
+        if r.returncode == 0:
+            rec["outcome"] = "tpu"
+        elif r.returncode == 3:
+            rec["outcome"] = "cpu_verdict"
+        else:
+            rec["outcome"] = f"error_rc{r.returncode}"
+            rec["stderr"] = r.stderr[-200:]
+    except subprocess.TimeoutExpired:
+        rec["outcome"] = f"hang_timeout_{timeout:.0f}s"
+    except OSError as e:
+        # the loop must survive spawn failures (fd exhaustion etc.)
+        rec["outcome"] = f"spawn_error:{e!r}"[:160]
+    return rec
+
+
+def _live_ok() -> bool:
+    try:
+        with open(LIVE) as f:
+            return bool(json.load(f).get("value", 0))
+    except (OSError, ValueError):
+        return False
+
+
+def run_bench() -> bool:
+    """Full bench pinned to TPU; True if a line with value>0 was captured."""
+    env = dict(os.environ)
+    env.update(MOSAIC_BENCH_PLATFORM="tpu", MOSAIC_BENCH_NO_REEXEC="1")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, timeout=1800, capture_output=True, text=True, cwd=REPO,
+        )
+        line = json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — any failure is just a trail entry
+        log({"outcome": f"bench_fail:{e!r}"[:200], "bench_s": round(time.time() - t0, 1)})
+        return False
+    line.setdefault("detail", {})["bench_wall_s"] = round(time.time() - t0, 1)
+    stamp = time.strftime("%H%M%S")
+    with open(os.path.join(REPO, f"BENCH_TPU_LIVE_{stamp}.json"), "w") as f:
+        json.dump(line, f, indent=1)
+    ok = bool(line.get("value", 0))
+    if ok:  # LIVE only ever holds a real accelerator number
+        with open(LIVE, "w") as f:
+            json.dump(line, f, indent=1)
+    log({"outcome": "bench_ok" if ok else "bench_zero",
+         "value": line.get("value"), "bench_s": round(time.time() - t0, 1)})
+    return ok
+
+
+def main() -> None:
+    last_bench = time.time() - REBENCH_S if _live_ok() else None
+    while True:
+        rec = probe()
+        rec["phase"] = "post-bench" if last_bench else "hunting"
+        log(rec)
+        if rec["outcome"] == "tpu" and (
+            last_bench is None or time.time() - last_bench >= REBENCH_S
+        ):
+            if run_bench():
+                last_bench = time.time()
+        # hunt aggressively until we have a number, then back off
+        time.sleep(120.0 if last_bench else 30.0)
+
+
+if __name__ == "__main__":
+    main()
