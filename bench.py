@@ -64,14 +64,10 @@ _I32_MAX = np.iinfo(np.int32).max
 
 
 def _np_parity(px, py, e, bits):
-    ax, ay, bx, by = e[..., 0], e[..., 1], e[..., 2], e[..., 3]
-    st = (ay > py[:, None]) != (by > py[:, None])
-    den = np.where(by == ay, 1.0, by - ay)
-    xc = ax + (py[:, None] - ay) * (bx - ax) / den
-    cr = st & (px[:, None] < xc)
-    return np.bitwise_xor.reduce(
-        np.where(cr, bits, np.uint32(0)).astype(np.uint32), axis=1
-    )
+    # single source of truth for the host parity lives in the library
+    from mosaic_tpu.sql.join import _np_parity as lib_parity
+
+    return lib_parity(px, py, e, bits)
 
 
 def _numpy_join(points, index, pcells):
@@ -247,7 +243,7 @@ def _maybe_late_tpu_retry(obj: dict) -> dict:
     return obj
 
 
-_CACHE_VERSION = 4  # bump when ChipIndex layout changes
+_CACHE_VERSION = 5  # bump when ChipIndex/HostRecheck layout changes
 
 
 def _load_or_build_index(zones, zones_src: str, h3):
@@ -257,7 +253,7 @@ def _load_or_build_index(zones, zones_src: str, h3):
 
     from mosaic_tpu.core.geometry.device import DeviceGeometry
     from mosaic_tpu.core.tessellate import tessellate
-    from mosaic_tpu.sql.join import ChipIndex, build_chip_index
+    from mosaic_tpu.sql.join import ChipIndex, HostRecheck, build_chip_index
 
     import zlib
 
@@ -282,6 +278,7 @@ def _load_or_build_index(zones, zones_src: str, h3):
                 border=border,
                 **{n: jnp.asarray(z[n]) for n in index_names},
             )
+            ix.host = HostRecheck.from_arrays(z)  # f64 recheck companion
             return ix, True, None
         except Exception:
             pass  # stale/corrupt cache: rebuild
@@ -296,6 +293,7 @@ def _load_or_build_index(zones, zones_src: str, h3):
             **{n: np.asarray(getattr(index, n)) for n in index_names},
             **{f"b_{n}": np.asarray(getattr(index.border, n))
                for n in border_names},
+            **index.host.save_arrays(),
         )
     except OSError:
         pass
@@ -708,6 +706,133 @@ def main():
             detail["join_f32_f64_agreement"] = round(jagree, 6)
             if jagree < 0.998:
                 detail["join_f32_f64_floor_violated"] = True
+
+        # epsilon-band borderline recheck lane (SURVEY §7, VERDICT r4 #3):
+        # band sizes, corrected agreement vs the exact f64 host oracle
+        # (the bar is EXACTLY 1.0), and the throughput cost of the band-
+        # instrumented step. On TPU the full fused step is timed over the
+        # same staged passes; on CPU a 60k eager-path subsample checks
+        # correctness only (the fused compile costs minutes there).
+        try:
+            from mosaic_tpu.sql.join import (
+                CELL_MARGIN_K,
+                EDGE_BAND_K,
+                _compact,
+                host_join,
+                pip_join,
+            )
+
+            rc: dict = {}
+            detail["recheck"] = rc
+            host = index.host
+            cell_np = np.float32 if cell_dtype == jnp.float32 else np.float64
+            km_val = CELL_MARGIN_K * float(np.finfo(cell_np).eps)
+            eps2_val = (
+                EDGE_BAND_K * float(np.finfo(np.dtype(dtype)).eps)
+                * host.coord_scale
+            ) ** 2
+            if on_tpu or force_lanes:
+                flag_cap = max(8, batch // 8)
+
+                @jax.jit
+                def step_rc(points_f64, chip_index):
+                    cells, margins = h3.point_to_cell_margin(
+                        points_f64.astype(cell_dtype), RES
+                    )
+                    cells = cells.astype(jnp.int64)
+                    shifted = (
+                        points_f64 - chip_index.border.shift
+                    ).astype(dtype)
+                    out, near = pip_join_points(
+                        shifted, cells, chip_index,
+                        heavy_cap=hcap, found_cap=fcap,
+                        edge_eps2=jnp.asarray(eps2_val, dtype),
+                    )
+                    flagged = margins[..., 0] < km_val
+                    srcF, validF, overF = _compact(flagged, flag_cap)
+                    alt = h3.point_to_cell_alt(
+                        points_f64[srcF].astype(cell_dtype), RES
+                    ).astype(jnp.int64)
+                    r_alt = pip_join_points(shifted[srcF], alt, chip_index)
+                    tie = validF & (
+                        (r_alt != out[srcF])
+                        | (margins[srcF, 1] < km_val)
+                        | (alt < 0)
+                    )
+                    esc = (near | overF).at[srcF].max(tie)
+                    return out, esc, flagged
+
+                # compile + timed passes over the same staged batches
+                float(_fold(step_rc(staged_passes[0][0], index)[0]))
+                rc_times = []
+                outs_rc0 = None
+                for p, sp in enumerate(staged_passes):
+                    t0 = time.perf_counter()
+                    outs = [step_rc(sb, index) for sb in sp]
+                    tot = None
+                    for o, e, f in outs:
+                        s = _fold(o) + e.sum() + f.sum()
+                        tot = s if tot is None else tot + s
+                    float(tot)
+                    rc_times.append(round(time.perf_counter() - t0, 4))
+                    if p == 0:
+                        outs_rc0 = outs
+                rc_dev_s = max(min(rc_times) - rtt, 1e-9)
+                rc["passes_s"] = rc_times
+                rc["device_cost_frac"] = round(rc_dev_s / dev_s - 1.0, 4)
+                # correctness on pass-0 batch 0 vs the exact host oracle
+                o0, e0, f0 = outs_rc0[0]
+                out_np = np.asarray(o0)
+                esc_np = np.asarray(e0)
+                flag_np = np.asarray(f0)
+                pts0 = all_pts[:batch]
+                rows = np.nonzero(esc_np)[0]
+                t0 = time.perf_counter()
+                corrected = np.array(out_np)
+                if rows.size:
+                    corrected[rows] = host_join(pts0[rows], host, h3, RES)
+                host_s = time.perf_counter() - t0
+                rc["host_recheck_s"] = round(host_s, 4)
+                rc["host_cost_frac"] = round(host_s / max(rc_dev_s, 1e-9), 4)
+                t0 = time.perf_counter()
+                truth = host_join(pts0, host, h3, RES)
+                detail["host_oracle_points_per_sec"] = round(
+                    batch / (time.perf_counter() - t0), 1
+                )
+                rc["band_frac"] = round(float(flag_np.mean()), 5)
+                rc["esc_frac"] = round(float(esc_np.mean()), 5)
+                rc["join_agreement_before"] = round(
+                    float((out_np == truth).mean()), 6
+                )
+                rc["join_agreement_after"] = float(
+                    (corrected == truth).mean()
+                )
+                # cell-level closure: flagged rows take the f64 cell
+                c32 = np.asarray(cells_of(jnp.asarray(pts0)))
+                c64h = np.asarray(h3.point_to_cell(pts0, RES))
+                rc["cell_agreement_after"] = float(
+                    ((c32 == c64h) | flag_np).mean()
+                )
+            else:
+                sub = all_pts[:60_000]
+                got = pip_join(
+                    sub, None, h3, RES, chip_index=index,
+                    recheck=True, cell_dtype=jnp.float32,
+                )
+                truth = host_join(sub, host, h3, RES)
+                rc["join_agreement_after"] = float((got == truth).mean())
+                import jax.numpy as _jnp
+
+                _, m = h3.point_to_cell_margin(
+                    _jnp.asarray(sub, dtype=_jnp.float32), RES
+                )
+                m = np.asarray(m)
+                rc["band_frac"] = round(
+                    float((m[:, 0] < km_val).mean()), 5
+                )
+                rc["mode"] = "cpu_subsample_60k"
+        except Exception as e:  # the lane must not kill the bench
+            detail["recheck_error"] = repr(e)[:300]
 
         obj = {
             "metric": "nyc_pip_join_throughput",

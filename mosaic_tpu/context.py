@@ -61,6 +61,10 @@ class MosaicConfig:
     # f64) | 'native' (independent C++ second engine, ESRI-engine role)
     cell_id_type: str = "long"  # 'long' | 'string'
     raster_checkpoint: str = "/tmp/mosaic_tpu/raster_checkpoint"
+    #: epsilon-band borderline recheck in `sql.join.pip_join` (SURVEY §7
+    #: precision strategy): borderline f32 cell/edge decisions re-evaluate
+    #: against the f64 host oracle; off by default (pure-throughput mode)
+    exact_recheck: bool = False
 
     def __post_init__(self):
         if self.geometry_backend not in ("device", "oracle", "native"):

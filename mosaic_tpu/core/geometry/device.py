@@ -60,33 +60,45 @@ class DeviceGeometry:
         return idx[None, :] < self.n_rings[:, None]
 
 
+def recenter_shift(padded: PaddedGeometry) -> np.ndarray:
+    """The f64 origin ``to_device(recenter=True)`` subtracts — exposed so
+    host-side f64 companions (`sql.join.HostRecheck`) share the exact
+    coordinate frame of the narrowed device column."""
+    verts = np.asarray(padded.verts, dtype=np.float64)
+    mask = padded.vert_mask()
+    if not mask.any():
+        return np.zeros(2)
+    lo = np.array([verts[..., 0][mask].min(), verts[..., 1][mask].min()])
+    hi = np.array([verts[..., 0][mask].max(), verts[..., 1][mask].max()])
+    return (lo + hi) / 2.0
+
+
 def to_device(
     padded: PaddedGeometry,
     dtype=jnp.float32,
     recenter: bool = False,
+    shifted_verts: np.ndarray | None = None,
+    shift: np.ndarray | None = None,
 ) -> DeviceGeometry:
+    """``shifted_verts``/``shift`` let a caller that already recentered the
+    f64 vertex array (`sql.join.build_chip_index` keeps it as the
+    HostRecheck companion) skip the duplicate min/max + subtract pass."""
     if not padded.rings_closed:
         raise ValueError(
             "DeviceGeometry kernels assume closed polygon rings; build the "
             "PaddedGeometry with close_rings=True"
         )
-    verts = np.asarray(padded.verts, dtype=np.float64)
-    if recenter:
-        mask = padded.vert_mask()
-        if mask.any():
-            lo = np.array(
-                [verts[..., 0][mask].min(), verts[..., 1][mask].min()]
-            )
-            hi = np.array(
-                [verts[..., 0][mask].max(), verts[..., 1][mask].max()]
-            )
-            shift = (lo + hi) / 2.0
-        else:
-            shift = np.zeros(2)
+    if shifted_verts is not None:
+        verts = shifted_verts
+        shift = np.zeros(2) if shift is None else shift
+    elif recenter:
+        verts = np.asarray(padded.verts, dtype=np.float64)
+        shift = recenter_shift(padded)
         verts = np.where(
             (padded.ring_len[:, :, None] > 0)[..., None], verts - shift, 0.0
         )
     else:
+        verts = np.asarray(padded.verts, dtype=np.float64)
         shift = np.zeros(2)
     return DeviceGeometry(
         verts=jnp.asarray(verts, dtype=dtype),

@@ -134,6 +134,27 @@ class BNGIndexSystem(IndexSystem):
         )
         return cell.astype(jnp.int64)
 
+    def point_to_cell_margin(self, xy: jax.Array, resolution: int):
+        """Cells plus the relative distance to the nearest binning
+        boundary. BNG bins are axis-aligned at multiples of the (quadrant-
+        halved) divisor; using the dense multiple set is conservative —
+        never misses a real boundary (`sql.join` epsilon-band recheck)."""
+        res = resolution
+        xp = jnp if isinstance(xy, jax.Array) else np
+        xy = xp.asarray(xy)
+        cells = self.point_to_cell(xy, res)
+        if res == -1:
+            b = 500_000.0
+        else:
+            divisor = 10 ** (7 - abs(res)) if res < 0 else 10 ** (6 - res)
+            b = min(float(divisor) / (2.0 if res < -1 else 1.0), 100_000.0)
+        e, n = xy[..., 0], xy[..., 1]
+        de = xp.abs(e / b - xp.round(e / b)) * b
+        dn = xp.abs(n / b - xp.round(n / b)) * b
+        s = xp.maximum(xp.maximum(xp.abs(e), xp.abs(n)), 1.0)
+        m = xp.stack([xp.minimum(de, dn), xp.maximum(de, dn)], axis=-1)
+        return cells, m / s[..., None]
+
     def _decode(self, cells: jax.Array):
         """cells -> (res_static_unavailable) x,y SW corner, edge, quad.
 

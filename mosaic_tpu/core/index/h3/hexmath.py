@@ -117,6 +117,65 @@ def hex2d_to_axial(x, y, xp=np):
     return rq.astype(np.int64), (-rr).astype(np.int64)
 
 
+def _hex_round_rel(x, y, xp):
+    """Shared by the margin/alt helpers: rounded axial (ii, jj), the
+    residual (dx, dy) from the rounded center, and the three |projections|
+    onto the Voronoi boundary normals (1,0), (1/2,sin60), (−1/2,sin60)."""
+    ii, jj = hex2d_to_axial(x, y, xp)
+    iif = ii.astype(x.dtype)
+    jjf = jj.astype(x.dtype)
+    dx = x - (iif - 0.5 * jjf)
+    dy = y - jjf * C.SIN60
+    p1 = dx
+    p2 = 0.5 * dx + C.SIN60 * dy
+    p3 = -0.5 * dx + C.SIN60 * dy
+    return ii, jj, p1, p2, p3
+
+
+def hex_round_margins(x, y, xp=np):
+    """Distances (hex2d units) from (x, y) to the nearest and second-
+    nearest Voronoi boundaries of its rounded hex — how far the finest-res
+    cell decision is from flipping under coordinate noise (second margin
+    small = near a cell VERTEX, where three cells meet).
+
+    The Voronoi cell of this lattice (six unit neighbors (±1, 0),
+    ±(1/2, sin60), ±(−1/2, sin60)) is the regular hexagon of inradius 1/2
+    centred on the rounded lattice point, bounded by the planes
+    p·u_d = 1/2; margin = 1/2 − |p_rel·u_d|, sorted ascending over the
+    three boundary-normal axes.  The first may come out slightly negative
+    where the cube-rounding tie-fix picks the other center — those points
+    are maximally borderline, which the epsilon-band consumer (`sql.join`
+    recheck) treats correctly.
+    """
+    _, _, p1, p2, p3 = _hex_round_rel(x, y, xp)
+    a1, a2, a3 = xp.abs(p1), xp.abs(p2), xp.abs(p3)
+    hi = xp.maximum(a1, xp.maximum(a2, a3))
+    lo = xp.minimum(a1, xp.minimum(a2, a3))
+    mid = a1 + a2 + a3 - hi - lo
+    return 0.5 - hi, 0.5 - mid
+
+
+def hex_round_alt_axial(x, y, xp=np):
+    """Runner-up lattice point of the hex rounding (unnormalized axial):
+    the neighbor across the NEAREST Voronoi boundary.  For a point within
+    an epsilon band of one boundary (and only one — vertex neighborhoods
+    need a third candidate, see :func:`hex_round_margins`), the exact-
+    precision rounding lands on either the primary or this alternate."""
+    ii, jj, p1, p2, p3 = _hex_round_rel(x, y, xp)
+    a1, a2, a3 = xp.abs(p1), xp.abs(p2), xp.abs(p3)
+    use1 = (a1 >= a2) & (a1 >= a3)
+    use2 = ~use1 & (a2 >= a3)
+    one = xp.ones_like(ii)
+    s1 = xp.where(p1 >= 0, one, -one)
+    s2 = xp.where(p2 >= 0, one, -one)
+    s3 = xp.where(p3 >= 0, one, -one)
+    # boundary-normal -> axial neighbor offset: (1,0)->(1,0),
+    # (1/2,sin60)->(1,1), (-1/2,sin60)->(0,1)  [x = ii - jj/2, y = jj sin60]
+    di = xp.where(use1, s1, xp.where(use2, s2, xp.zeros_like(ii)))
+    dj = xp.where(use1, xp.zeros_like(jj), xp.where(use2, s2, s3))
+    return ii + di, jj + dj
+
+
 def _round_div7(n, xp):
     """Exact integer round-to-nearest(n / 7): floor((2n + 7) / 14).
 
@@ -231,40 +290,85 @@ def select_rows(idx, table, n_rows: int, xp):
     return out
 
 
+_COS_AP7 = float(np.cos(C.AP7_ROT_RADS))
+_SIN_AP7 = float(np.sin(C.AP7_ROT_RADS))
+
+_FACE_BASIS_CACHE = None  # (20, 9) f64: [face center vec3, e_i, e_j]
+
+
+def _face_basis() -> np.ndarray:
+    """Per-face orthonormal tangent basis of the gnomonic plane, aligned
+    with the face's class-II i-axis azimuth.
+
+    Derived numerically in f64 from the azimuthal definition itself
+    (a geodesic leaving the face center at azimuth ``az_i`` maps to the
+    +x ray; gnomonic projection sends center geodesics to straight rays,
+    so a single short arc fixes the direction exactly), keeping the
+    convention consistent with :func:`geo_az_distance` / the polar inverse
+    by construction."""
+    global _FACE_BASIS_CACHE
+    if _FACE_BASIS_CACHE is None:
+        rows = []
+        for f in range(20):
+            flat = np.float64(C.FACE_CENTER_GEO[f, 0])
+            flng = np.float64(C.FACE_CENTER_GEO[f, 1])
+            azif = float(C.FACE_AXES_AZ_I[f])
+            fv = geo_to_vec3(flat, flng)
+
+            def ray(az):
+                la, lo = geo_az_distance(
+                    flat, flng, np.float64(az), np.float64(1e-3)
+                )
+                v = geo_to_vec3(la, lo)
+                p = v / float(v @ fv) - fv
+                return p / np.linalg.norm(p)
+
+            e_i = ray(azif)
+            # theta = az_i − az: a point at azimuth az_i − π/2 has θ=+π/2
+            e_j = ray(azif - np.pi / 2.0)
+            e_j = e_j - float(e_j @ e_i) * e_i
+            e_j = e_j / np.linalg.norm(e_j)
+            rows.append(np.concatenate([fv, e_i, e_j]))
+        _FACE_BASIS_CACHE = np.asarray(rows)
+    return _FACE_BASIS_CACHE
+
+
 def geo_to_hex2d(lat, lng, res: int, face=None, xp=np):
     """Project geo onto a face's gnomonic plane in res-scaled hex units.
 
     If ``face`` is None the nearest face is used (returned alongside x, y).
+
+    Vector form of the gnomonic: p = v/(v·fc) − fc dotted with the face's
+    tangent basis. Numerically stable everywhere on the face — the polar
+    form (azimuth → arccos → tan → cos/sin θ) carries ~eps of ABSOLUTE
+    angle error per step, which the res scaling turns into hex-space
+    displacement up to ~100·eps·coordinate-scale (arccos: eps/sin r near
+    the face center; azimuth wraps: rr·Δθ near the edges), breaking the
+    epsilon-band noise model the borderline recheck calibrates against.
+    Here every operand is an O(1) vector difference: absolute error stays
+    a few eps, and five transcendentals leave the hot path.
     """
-    face_given = face is not None
+    v = geo_to_vec3(lat, lng, xp)
     if face is None:
-        face, cosdist = nearest_face(lat, lng, xp)
-        r = xp.arccos(cosdist)
+        face, _ = nearest_face(lat, lng, xp)
+    basis = _face_basis()
     if xp is np:
-        flat = C.FACE_CENTER_GEO[face, 0]
-        flng = C.FACE_CENTER_GEO[face, 1]
-        azif = C.FACE_AXES_AZ_I[face]
+        b = basis[face]
     else:
-        # one select-chain instead of three per-point gathers
         dt = lat.dtype if hasattr(lat, "dtype") else np.float64
-        geo_tab = np.stack(
-            [C.FACE_CENTER_GEO[:, 0], C.FACE_CENTER_GEO[:, 1], C.FACE_AXES_AZ_I],
-            axis=1,
-        ).astype(dt)
-        f3 = select_rows(face, geo_tab, 20, xp)
-        flat, flng, azif = f3[..., 0], f3[..., 1], f3[..., 2]
-    if face_given:
-        v = geo_to_vec3(lat, lng, xp)
-        fv = geo_to_vec3(flat, flng, xp)
-        r = xp.arccos(xp.clip(xp.sum(v * fv, axis=-1), -1.0, 1.0))
-    az = geo_azimuth(flat, flng, lat, lng, xp)
-    theta = pos_angle(azif - pos_angle(az, xp), xp)
-    if is_class_iii(res):
-        theta = pos_angle(theta - C.AP7_ROT_RADS, xp)
-    rr = xp.tan(r) / C.RES0_U_GNOMONIC
-    rr = rr * (C.SQRT7 ** res)
-    x = rr * xp.cos(theta)
-    y = rr * xp.sin(theta)
+        b = select_rows(face, basis.astype(dt), 20, xp)
+    fv = b[..., 0:3]
+    dot = xp.sum(v * fv, axis=-1)
+    # nearest-face dot ≥ cos(face circumradius) ≈ 0.85; the floor only
+    # guards exotic face-given calls from dividing by ~0
+    p = v / xp.maximum(dot, 0.2)[..., None] - fv
+    gx = xp.sum(p * b[..., 3:6], axis=-1)
+    gy = xp.sum(p * b[..., 6:9], axis=-1)
+    scale = float(C.SQRT7**res / C.RES0_U_GNOMONIC)
+    x = gx * scale
+    y = gy * scale
+    if is_class_iii(res):  # θ −= AP7 rotation, applied as an exact 2x2
+        x, y = x * _COS_AP7 + y * _SIN_AP7, y * _COS_AP7 - x * _SIN_AP7
     return face, x, y
 
 

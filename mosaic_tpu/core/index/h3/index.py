@@ -66,6 +66,28 @@ class H3IndexSystem(IndexSystem):
         lat = xp.radians(xy[..., 1])
         return core.geo_to_cell(lat, lng, resolution, xp)
 
+    def point_to_cell_margin(self, xy, resolution: int):
+        """Cells plus the (..., 2) relative margins of the finest-res hex
+        rounding (nearest and second-nearest boundary; see
+        `core._rel_margin`) — the epsilon-band input for the f64
+        borderline recheck in `sql.join`."""
+        xp = jnp if isinstance(xy, jax.Array) else np
+        xy = xp.asarray(xy)
+        lng = xp.radians(xy[..., 0])
+        lat = xp.radians(xy[..., 1])
+        return core.geo_to_cell(lat, lng, resolution, xp, with_margin=True)
+
+    def point_to_cell_alt(self, xy, resolution: int) -> jax.Array:
+        """Runner-up cell of the finest-res rounding: for a point flagged
+        borderline (small first margin, ample second), the true f64 cell
+        is the primary or this one. -1 where no valid alternate exists
+        (face-overage corner) — callers escalate those to the host path."""
+        xp = jnp if isinstance(xy, jax.Array) else np
+        xy = xp.asarray(xy)
+        lng = xp.radians(xy[..., 0])
+        lat = xp.radians(xy[..., 1])
+        return core.geo_to_cell(lat, lng, resolution, xp, alt=True)
+
     def cell_center(self, cells) -> jax.Array:
         # eager jax arrays route through the host path so pentagon centers
         # get the round-trip-exact repair; only traced values stay on the

@@ -35,17 +35,23 @@ static shapes) and only that compacted subset pays the wide heavy gather.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.geometry.device import DeviceGeometry, pack_to_device
+from ..core.geometry.device import (
+    DeviceGeometry,
+    recenter_shift,
+    to_device,
+)
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
 
 _SENTINEL = jnp.iinfo(jnp.int32).max
+_I32_MAX = np.iinfo(np.int32).max
 _OVF_MARK = _SENTINEL - 1  # in-probe marker: tier-2 capacity exceeded
 
 #: per-cell flat edge capacity of the tier-1 probe; cells with more edges
@@ -59,6 +65,15 @@ MAX_SLOTS = 32
 #: result code for points whose heavy-cell probe exceeded ``heavy_cap``
 #: (unknown result; raise the cap — `pip_join` sizes it exactly)
 OVERFLOW = -2
+
+#: epsilon-band multipliers (SURVEY §7 precision strategy): a point is
+#: borderline when its cell-rounding margin (`IndexSystem.
+#: point_to_cell_margin`) is below CELL_MARGIN_K·eps(dtype) — calibrated
+#: against exhaustive f32-vs-f64 disagreement sets (max observed ≈ 2.8·eps
+#: globally at res 5/9; tests/test_recheck.py pins the 2x headroom) — or
+#: within EDGE_BAND_K·eps·coord_scale of a probed chip edge.
+CELL_MARGIN_K = 6.0
+EDGE_BAND_K = 16.0
 
 
 @jax.tree_util.register_dataclass
@@ -105,6 +120,10 @@ class ChipIndex:
     heavy_edges:     (H, E2, 4); heavy_ebits: (H, E2) uint32.
     heavy_slot_geom: (H, M2) int32 — geom per heavy chip slot, -1 pad.
     H == 0 when no cell is heavy (tier 2 compiles away entirely).
+
+    Instances built by :func:`build_chip_index` additionally carry a
+    ``host`` attribute (:class:`HostRecheck`, f64 host twin of the edge
+    tables) — not a dataclass field, so it stays out of the pytree.
     """
 
     cells: jax.Array
@@ -137,6 +156,115 @@ class ChipIndex:
     @property
     def num_heavy_cells(self) -> int:
         return int(self.heavy_edges.shape[0])
+
+
+@dataclasses.dataclass
+class HostRecheck:
+    """Host-side f64 companion of a :class:`ChipIndex`: the same flat
+    edge layout in full precision and the same recentring shift, built
+    from the pre-narrowing chip coordinates. This is the exact oracle the
+    epsilon-band recheck evaluates borderline points against (and a
+    standalone f64 reference join for tests/benchmarks via
+    :func:`host_join_with_cells`). Not a pytree — never crosses to device.
+    """
+
+    cells: np.ndarray  # (U,) int64 sorted
+    cell_edges: np.ndarray  # (U, E1, 4) float64
+    cell_ebits: np.ndarray
+    cell_slot_geom: np.ndarray
+    cell_slot_core: np.ndarray
+    cell_heavy: np.ndarray
+    heavy_edges: np.ndarray  # (H, E2, 4) float64
+    heavy_ebits: np.ndarray
+    heavy_slot_geom: np.ndarray
+    shift: np.ndarray  # (2,) float64
+    coord_scale: float  # max |recentered edge coordinate|
+
+    _FIELDS = (
+        "cells", "cell_edges", "cell_ebits", "cell_slot_geom",
+        "cell_slot_core", "cell_heavy", "heavy_edges", "heavy_ebits",
+        "heavy_slot_geom", "shift",
+    )
+
+    def save_arrays(self) -> dict:
+        """{name: array} for npz round-trips (bench index cache)."""
+        d = {f"hr_{n}": getattr(self, n) for n in self._FIELDS}
+        d["hr_coord_scale"] = np.asarray(self.coord_scale)
+        return d
+
+    @classmethod
+    def from_arrays(cls, z) -> "HostRecheck":
+        kw = {n: np.asarray(z[f"hr_{n}"]) for n in cls._FIELDS}
+        return cls(coord_scale=float(z["hr_coord_scale"]), **kw)
+
+
+def _np_parity(px, py, e, bits):
+    """Host twin of :func:`_ray_parity` (float64 numpy)."""
+    ax, ay, bx, by = e[..., 0], e[..., 1], e[..., 2], e[..., 3]
+    st = (ay > py[:, None]) != (by > py[:, None])
+    den = np.where(by == ay, 1.0, by - ay)
+    xc = ax + (py[:, None] - ay) * (bx - ax) / den
+    cr = st & (px[:, None] < xc)
+    return np.bitwise_xor.reduce(
+        np.where(cr, bits, np.uint32(0)).astype(np.uint32), axis=1
+    )
+
+
+def host_join_with_cells(
+    points: np.ndarray, cells: np.ndarray, host: HostRecheck
+) -> np.ndarray:
+    """(N,) int32 — exact f64 host evaluation of the join contract for
+    pre-assigned ``cells`` (raw, unshifted ``points``; same smallest-
+    matching-row semantics as :func:`pip_join_points`)."""
+    p = np.asarray(points, np.float64) - host.shift
+    out = np.full(p.shape[0], -1, dtype=np.int32)
+    U = host.cells.shape[0]
+    if U == 0:
+        return out
+    u = np.clip(np.searchsorted(host.cells, cells), 0, U - 1)
+    fi = np.nonzero(host.cells[u] == cells)[0]
+    if fi.size == 0:
+        return out
+    uf = u[fi]
+    px, py = p[fi, 0], p[fi, 1]
+    par = _np_parity(px, py, host.cell_edges[uf], host.cell_ebits[uf])
+    M = host.cell_slot_geom.shape[1]
+    inside = ((par[:, None] >> np.arange(M, dtype=np.uint32)) & 1).astype(bool)
+    g = host.cell_slot_geom[uf]
+    hit = (g >= 0) & (host.cell_slot_core[uf] | inside)
+    best = np.where(hit, g, _I32_MAX).min(axis=1)
+    if host.heavy_edges.shape[0]:
+        hrow = host.cell_heavy[uf]
+        hi_ = np.nonzero(hrow >= 0)[0]
+        if hi_.size:
+            h = hrow[hi_]
+            par2 = _np_parity(
+                px[hi_], py[hi_], host.heavy_edges[h], host.heavy_ebits[h]
+            )
+            M2 = host.heavy_slot_geom.shape[1]
+            in2 = (
+                (par2[:, None] >> np.arange(M2, dtype=np.uint32)) & 1
+            ).astype(bool)
+            g2 = host.heavy_slot_geom[h]
+            b2 = np.where((g2 >= 0) & in2, g2, _I32_MAX).min(axis=1)
+            best[hi_] = np.minimum(best[hi_], b2)
+    out[fi] = np.where(best == _I32_MAX, -1, best).astype(np.int32)
+    return out
+
+
+def host_join(
+    points: np.ndarray,
+    host: HostRecheck,
+    index_system: IndexSystem,
+    resolution: int,
+) -> np.ndarray:
+    """Exact f64 host join: f64 cell assignment (numpy host path, pentagon-
+    exact) + f64 flat-edge probe. Ground truth for the epsilon-band
+    recheck and the f32/f64 agreement metrics."""
+    cells = np.asarray(
+        index_system.point_to_cell(np.asarray(points, np.float64), resolution)
+    )
+    return host_join_with_cells(points, cells, host)
 
 
 def _build_hash(cells: np.ndarray, max_bucket: int = 8):
@@ -244,17 +372,30 @@ def build_chip_index(
     # recenter: chips span a city/region, so subtracting the f64 midpoint
     # before narrowing to f32 shrinks the coordinate ulp by ~1e3 (the
     # SURVEY §7 precision strategy) — points are shifted to match in
-    # pip_join before they are narrowed.
-    border = pack_to_device(chips, dtype=dtype, recenter=recenter)
+    # pip_join before they are narrowed. The padded host f64 coordinates
+    # are kept (HostRecheck) so the epsilon-band recheck evaluates against
+    # the TRUE chips, not their narrowed images; the device tables below
+    # narrow from these same host arrays (bitwise-identical to narrowing
+    # on device, no device round-trip).
+    padded = chips.to_padded(dtype=np.float64)
+    shift64 = recenter_shift(padded) if recenter else np.zeros(2)
+    bverts64 = np.where(
+        (np.asarray(padded.ring_len)[:, :, None] > 0)[..., None],
+        np.asarray(padded.verts, dtype=np.float64) - shift64,
+        0.0,
+    )
+    border = to_device(
+        padded, dtype=dtype, shifted_verts=bverts64, shift=shift64
+    )
 
     # probe fast path: hash table + flat per-cell edge rows
     mult, table_cell, table_slot, table_pack, pack_low = _build_hash(uniq)
 
     from ..core.types import GeometryType
 
-    bverts = np.asarray(border.verts)  # (C, R, V, 2), recentered, dtype
-    blen = np.asarray(border.ring_len)  # (C, R)
-    btype = np.asarray(border.geom_type)
+    bverts = bverts64.astype(np.dtype(dtype))  # (C, R, V, 2), recentered
+    blen = np.asarray(padded.ring_len)  # (C, R)
+    btype = np.asarray(padded.geom_type)
     is_poly = (btype == GeometryType.POLYGON) | (btype == GeometryType.MULTIPOLYGON)
     contributes = is_poly & ~table.is_core  # chips whose edges are probed
 
@@ -270,6 +411,9 @@ def build_chip_index(
     e_a = bverts[ec, er, ee]  # (E, 2)
     e_b = bverts[ec, er, ee + 1]
     edges_all = np.concatenate([e_a, e_b], axis=1).astype(bverts.dtype)  # (E,4)
+    edges_all64 = np.concatenate(
+        [bverts64[ec, er, ee], bverts64[ec, er, ee + 1]], axis=1
+    )  # (E, 4) f64 twin, same row order
     e_cell = chip_cell_slot[ec]  # (E,) cell row u per edge
 
     # per-cell edge totals decide light vs heavy
@@ -321,6 +465,7 @@ def build_chip_index(
     t1_edge = t1_slot[ec] >= 0
     E1 = _round8(min(int(epc.max(initial=0)), edge_cap))
     cell_edges = np.zeros((U, E1, 4), dtype=bverts.dtype)
+    cell_edges64 = np.zeros((U, E1, 4), dtype=np.float64)
     cell_ebits = np.zeros((U, E1), dtype=np.uint32)
     if t1_edge.any():
         cu = e_cell[t1_edge]
@@ -330,6 +475,7 @@ def build_chip_index(
         bits = np.uint32(1) << t1_slot[ec][t1_edge][ord1].astype(np.uint32)
         pos = np.arange(cu.size) - np.searchsorted(cu, cu)
         cell_edges[cu, pos] = ed
+        cell_edges64[cu, pos] = edges_all64[t1_edge][ord1]
         cell_ebits[cu, pos] = bits
 
     # pack tier-2 heavy rows
@@ -343,9 +489,11 @@ def build_chip_index(
         eph = np.bincount(hrow, minlength=H)
         E2 = _round8(int(eph.max(initial=1)))
         heavy_edges = np.zeros((H, E2, 4), dtype=bverts.dtype)
+        heavy_edges64 = np.zeros((H, E2, 4), dtype=np.float64)
         heavy_ebits = np.zeros((H, E2), dtype=np.uint32)
         pos2 = np.arange(hrow.size) - np.searchsorted(hrow, hrow)
         heavy_edges[hrow, pos2] = ed2
+        heavy_edges64[hrow, pos2] = edges_all64[t2_edge][ord2]
         heavy_ebits[hrow, pos2] = bits2
         hgeom = np.full((H, M2), -1, dtype=np.int32)
         ch2 = np.nonzero(chip_heavy_tier)[0]
@@ -354,10 +502,11 @@ def build_chip_index(
         ] = table.geom_id[ch2].astype(np.int32)
     else:
         heavy_edges = np.zeros((0, 8, 4), dtype=bverts.dtype)
+        heavy_edges64 = np.zeros((0, 8, 4), dtype=np.float64)
         heavy_ebits = np.zeros((0, 8), dtype=np.uint32)
         hgeom = np.zeros((0, 1), dtype=np.int32)
 
-    return ChipIndex(
+    idx = ChipIndex(
         cells=jnp.asarray(uniq, dtype=jnp.int64),
         chip_rows=jnp.asarray(rows),
         chip_geom=jnp.asarray(table.geom_id.astype(np.int32)),
@@ -377,15 +526,82 @@ def build_chip_index(
         heavy_ebits=jnp.asarray(heavy_ebits),
         heavy_slot_geom=jnp.asarray(hgeom),
     )
+    # host f64 companion for the epsilon-band recheck — a plain attribute,
+    # deliberately OUTSIDE the pytree (jit must never device-put it);
+    # absent on indexes reconstructed from flattened pytrees or plain
+    # deserialization (see HostRecheck.save_arrays for npz round-trips)
+    idx.host = HostRecheck(
+        cells=uniq.astype(np.int64),
+        cell_edges=cell_edges64,
+        cell_ebits=cell_ebits,
+        cell_slot_geom=slot_geom,
+        cell_slot_core=slot_core,
+        cell_heavy=cell_heavy,
+        heavy_edges=heavy_edges64,
+        heavy_ebits=heavy_ebits,
+        heavy_slot_geom=hgeom,
+        shift=shift64,
+        coord_scale=float(np.abs(edges_all64).max()) if edges_all64.size else 1.0,
+    )
+    return idx
 
 
-def _ray_parity(px, py, edges, bits):
+def _probe_slot(pcells: jax.Array, index: ChipIndex) -> jax.Array:
+    """(N,) cell ids -> (N,) cell row u, -1 on miss — the multiply-shift
+    hash probe (one gather on the slot-packed table when available)."""
+    T = index.table_cell.shape[0]
+    shift_bits = jnp.uint64(64 - int(np.log2(T)))
+    key = (
+        (pcells.astype(jnp.uint64) * index.hash_mult[0]) >> shift_bits
+    ).astype(jnp.int32)
+    if index.table_pack.shape[0]:
+        # slot-packed probe: one (N, B) gather carries cell + slot
+        low = index.pack_low[0]
+        ent = index.table_pack[key]  # (N, B)
+        slotp = (ent & low).astype(jnp.int32)
+        match = (
+            (((ent ^ pcells[:, None]) & ~low) == 0)
+            & (slotp > 0)
+            & ((pcells[:, None] & low) == index.pack_low[1])
+        )
+        return jnp.max(jnp.where(match, slotp - 1, -1), axis=1)  # (N,)
+    cand_cell = index.table_cell[key]  # (N, B)
+    cand_slot = index.table_slot[key]  # (N, B)
+    match = (cand_cell == pcells[:, None]) & (cand_slot >= 0)
+    return jnp.max(jnp.where(match, cand_slot, -1), axis=1)  # (N,)
+
+
+def _probe_counts(pcells: jax.Array, index: ChipIndex):
+    """Device-side exact compaction-cap inputs: one (2,) array of (found
+    count, heavy-cell count) — `pip_join` pulls these two ints in a single
+    transfer instead of the whole cell column (32 MB at 4M points over a
+    ~10 MB/s tunnel)."""
+    u = _probe_slot(pcells, index)
+    found = u >= 0
+    nf = found.sum()
+    if index.heavy_edges.shape[0]:
+        nh = (
+            jnp.where(found, index.cell_heavy[jnp.maximum(u, 0)], -1) >= 0
+        ).sum()
+    else:
+        nh = jnp.zeros((), nf.dtype)
+    return jnp.stack([nf, nh])
+
+
+_JIT_COUNTS = jax.jit(_probe_counts)
+
+
+def _ray_parity(px, py, edges, bits, eps2=None):
     """XOR-accumulated crossing parity bits.
 
     px, py: (...,); edges: (..., E, 4) ax/ay/bx/by; bits: (..., E) uint32
     (0 for pad edges — a zero edge has ay == by so it never straddles).
     Returns (...,) uint32 where bit m is the ray-crossing parity of chip
-    slot m.
+    slot m. With ``eps2`` (scalar, squared length), additionally returns
+    the epsilon-band mask: True where the point lies within sqrt(eps2) of
+    any real edge segment — the only geometry where the f32 crossing
+    decision can disagree with f64 (fused into the same pass so the edge
+    gather is paid once).
     """
     ax, ay = edges[..., 0], edges[..., 1]
     bx, by = edges[..., 2], edges[..., 3]
@@ -395,9 +611,18 @@ def _ray_parity(px, py, edges, bits):
     xcross = ax + (pyb - ay) * (bx - ax) / denom
     crossed = straddle & (pxb < xcross)
     vals = jnp.where(crossed, bits, jnp.zeros_like(bits))
-    return jax.lax.reduce(
+    par = jax.lax.reduce(
         vals, np.uint32(0), jax.lax.bitwise_xor, (vals.ndim - 1,)
     )
+    if eps2 is None:
+        return par
+    ex, ey = bx - ax, by - ay
+    qx, qy = pxb - ax, pyb - ay
+    dd = ex * ex + ey * ey
+    t = jnp.clip((qx * ex + qy * ey) / jnp.where(dd == 0, 1.0, dd), 0.0, 1.0)
+    rx, ry = qx - t * ex, qy - t * ey
+    near = jnp.any((rx * rx + ry * ry <= eps2) & (bits != 0), axis=-1)
+    return par, near
 
 
 def _slot_best(parity, geoms, cores=None):
@@ -480,6 +705,7 @@ def pip_join_points(
     index: ChipIndex,
     heavy_cap: int | None = None,
     found_cap: int | None = None,
+    edge_eps2: jax.Array | None = None,
 ) -> jax.Array:
     """(N,) int32 — smallest matching polygon row per point, -1 if none.
 
@@ -495,30 +721,15 @@ def pip_join_points(
     their exact upper bound (N / found_cap), so an uncapped call is always
     exact — tighter caps are a performance knob. If a cap is exceeded the
     excess points return :data:`OVERFLOW` (-2) instead of a wrong answer;
-    `pip_join` sizes both caps exactly from host-side counts.
+    `pip_join` sizes both caps exactly from device-side counts.
+
+    ``edge_eps2`` (scalar array, squared length) switches on the epsilon
+    band: returns ``(out, near)`` where ``near`` marks points within
+    sqrt(edge_eps2) of any probed chip edge — the set whose f32 parity may
+    disagree with f64 (`pip_join` rechecks them on the host oracle).
     """
     N = points.shape[0]
-    T = index.table_cell.shape[0]
-    shift_bits = jnp.uint64(64 - int(np.log2(T)))
-    key = (
-        (pcells.astype(jnp.uint64) * index.hash_mult[0]) >> shift_bits
-    ).astype(jnp.int32)
-    if index.table_pack.shape[0]:
-        # slot-packed probe: one (N, B) gather carries cell + slot
-        low = index.pack_low[0]
-        ent = index.table_pack[key]  # (N, B)
-        slotp = (ent & low).astype(jnp.int32)
-        match = (
-            (((ent ^ pcells[:, None]) & ~low) == 0)
-            & (slotp > 0)
-            & ((pcells[:, None] & low) == index.pack_low[1])
-        )
-        u = jnp.max(jnp.where(match, slotp - 1, -1), axis=1)  # (N,)
-    else:
-        cand_cell = index.table_cell[key]  # (N, B)
-        cand_slot = index.table_slot[key]  # (N, B)
-        match = (cand_cell == pcells[:, None]) & (cand_slot >= 0)
-        u = jnp.max(jnp.where(match, cand_slot, -1), axis=1)  # (N,)
+    u = _probe_slot(pcells, index)
     found = u >= 0
 
     K1 = int(found_cap) if found_cap else N
@@ -527,7 +738,12 @@ def pip_join_points(
     us = jnp.maximum(u[src1], 0)  # (K1,)
     px, py = points[src1, 0], points[src1, 1]
 
-    parity = _ray_parity(px, py, index.cell_edges[us], index.cell_ebits[us])
+    banded = edge_eps2 is not None
+    r1 = _ray_parity(
+        px, py, index.cell_edges[us], index.cell_ebits[us],
+        eps2=edge_eps2,
+    )
+    parity, near1 = r1 if banded else (r1, None)
     best1 = _slot_best(
         parity, index.cell_slot_geom[us], index.cell_slot_core[us]
     )
@@ -541,9 +757,11 @@ def pip_join_points(
         hs = jnp.where(valid1, index.cell_heavy[us], -1)
         src2, valid2, over2 = _compact(hs >= 0, K2)
         h2 = jnp.maximum(hs[src2], 0)
-        par2 = _ray_parity(
-            px[src2], py[src2], index.heavy_edges[h2], index.heavy_ebits[h2]
+        r2 = _ray_parity(
+            px[src2], py[src2], index.heavy_edges[h2], index.heavy_ebits[h2],
+            eps2=edge_eps2,
         )
+        par2, near2 = r2 if banded else (r2, None)
         best2k = jnp.where(
             valid2, _slot_best(par2, index.heavy_slot_geom[h2]), _SENTINEL
         )
@@ -554,6 +772,10 @@ def pip_join_points(
         # an overflowed tier-2 point has an unknown answer even if tier 1
         # hit: mark it (marker < SENTINEL so the scatter-min keeps it)
         best1 = jnp.where(over2, _OVF_MARK, best1)
+        if banded:
+            near1 = near1 | (
+                jnp.zeros(K1, bool).at[src2].max(near2 & valid2)
+            )
 
     # scatter compacted results back to the full point axis
     best = (
@@ -563,7 +785,11 @@ def pip_join_points(
     )
     out = jnp.where(best == _SENTINEL, -1, best).astype(jnp.int32)
     out = jnp.where(best == _OVF_MARK, OVERFLOW, out)
-    return jnp.where(over1, OVERFLOW, out)
+    out = jnp.where(over1, OVERFLOW, out)
+    if banded:
+        near = jnp.zeros(N, bool).at[src1].max(near1 & valid1)
+        return out, near
+    return out
 
 
 # module-level jit so repeated pip_join calls share the compilation cache
@@ -574,56 +800,177 @@ def _next_pow2(n: int, lo: int = 16) -> int:
     return max(lo, 1 << int(np.ceil(np.log2(max(n, 1)))))
 
 
+@functools.lru_cache(maxsize=64)
+def _cells_prog(index_system: IndexSystem, resolution: int, variant: str):
+    """Cached jitted cell-assignment programs per (system, res, variant).
+
+    The lru key keeps a reference to the index system — idempotent systems
+    (all built-ins) are cheap singletons, so the retention is harmless.
+    """
+    if variant == "margin":
+        fn = lambda p: index_system.point_to_cell_margin(p, resolution)  # noqa: E731
+    elif variant == "alt":
+        fn = lambda p: index_system.point_to_cell_alt(p, resolution)  # noqa: E731
+    else:
+        fn = lambda p: index_system.point_to_cell(p, resolution)  # noqa: E731
+    return jax.jit(fn)
+
+
+#: below this batch size on CPU, eager per-op dispatch of the cell
+#: pipeline beats its XLA compile (measured ~1 min+ for the unrolled H3
+#: digit pipeline on CPU x64). On accelerators always jit: eager would pay
+#: the ~28 ms tunnel RTT per op, and the compile caches across batches.
+_JIT_CELLS_MIN = 65536
+
+
+def _assign_cells(index_system, resolution: int, dev: jax.Array, variant: str):
+    if (
+        dev.shape[0] >= _JIT_CELLS_MIN
+        or jax.devices()[0].platform != "cpu"
+    ):
+        return _cells_prog(index_system, resolution, variant)(dev)
+    if variant == "margin":
+        return index_system.point_to_cell_margin(dev, resolution)
+    if variant == "alt":
+        return index_system.point_to_cell_alt(dev, resolution)
+    return index_system.point_to_cell(dev, resolution)
+
+
 def pip_join(
     points: np.ndarray | jax.Array,
-    polygons: PackedGeometry,
+    polygons: PackedGeometry | None,
     index_system: IndexSystem,
     resolution: int,
     chip_index: ChipIndex | None = None,
     batch_size: int | None = None,
+    recheck: bool | None = None,
+    cell_dtype=None,
 ) -> np.ndarray:
     """Managed join (reference: `PointInPolygonJoin.join` auto-indexes both
     sides, `sql/join/PointInPolygonJoin.scala:86-97`).
 
     Tessellates ``polygons`` (unless a prebuilt ``chip_index`` is passed),
-    assigns cells to ``points`` and returns the matched polygon row per
-    point (-1 = no polygon). ``batch_size`` chunks the point axis to bound
-    the probe intermediates. The heavy-tier capacity is sized exactly from
-    the realized heavy-cell hit count, so no point can overflow.
+    assigns cells to ``points`` on device and returns the matched polygon
+    row per point (-1 = no polygon). ``batch_size`` chunks the point axis
+    to bound the probe intermediates. Compaction caps are sized exactly
+    from two device-side scalar counts (no cell column ever crosses back
+    to the host), so no point can overflow.
+
+    ``recheck`` (default: the ``exact_recheck`` config flag) switches on
+    the epsilon-band borderline recheck — the SURVEY §7 precision
+    contract: points whose cell-rounding margin or chip-edge distance is
+    within a few ulps of flipping are re-evaluated exactly. Escalation is
+    tiered so the exact host oracle only sees genuine ties: borderline
+    cell assignments first re-join against the runner-up cell ON DEVICE
+    (`IndexSystem.point_to_cell_alt`); only points where the two
+    candidate answers differ — plus cell-corner neighborhoods, invalid
+    alternates, and edge-band points — go to the f64 host path
+    (:func:`host_join`). Requires the index's ``host`` companion (present
+    on any `build_chip_index` product).
+
+    ``cell_dtype`` forces the dtype cells are computed in (default: the
+    input device array's dtype — f32 on TPU) — lets CPU/x64 tests
+    reproduce TPU f32 behavior exactly.
     """
     resolution = index_system.resolution_arg(resolution)
     if chip_index is None:
         table = tessellate(polygons, index_system, resolution, keep_core_geoms=False)
         chip_index = build_chip_index(table)
+    if recheck is None:
+        from ..context import current_config
+
+        recheck = current_config().exact_recheck
+    host: HostRecheck | None = getattr(chip_index, "host", None)
+    if recheck and host is None:
+        raise ValueError(
+            "exact_recheck needs the index's f64 host companion — present "
+            "on build_chip_index products; rebuild the index in-process "
+            "or restore it via HostRecheck.from_arrays"
+        )
     raw = np.asarray(points, dtype=np.float64)
     # shift in f64 first, narrow after (keeps f32 ulp small near the data)
-    shift = np.asarray(chip_index.border.shift, dtype=np.float64)
+    shift = (
+        host.shift
+        if host is not None
+        else np.asarray(chip_index.border.shift, dtype=np.float64)
+    )
     dtype = chip_index.border.verts.dtype
     n = raw.shape[0]
-    index_cells = np.asarray(chip_index.cells)
-    heavy_cells = None
-    if chip_index.num_heavy_cells:
-        hmask = np.asarray(chip_index.cell_heavy) >= 0
-        heavy_cells = index_cells[hmask]
 
     def run(chunk: np.ndarray) -> np.ndarray:
-        cells = index_system.point_to_cell(jnp.asarray(chunk), resolution)
-        # size both compaction caps exactly (pow2-bucketed to bound the
-        # number of distinct compiled programs) — overflow impossible
-        cnp = np.asarray(cells)
-        pos = np.clip(np.searchsorted(index_cells, cnp), 0, index_cells.size - 1)
-        fnp = index_cells[pos] == cnp
-        fcap = min(_next_pow2(int(fnp.sum()) + 1), chunk.shape[0])
-        hcap = None
-        if heavy_cells is not None:
-            n_heavy = int(np.isin(cnp[fnp], heavy_cells).sum())
-            hcap = min(_next_pow2(n_heavy + 1), fcap)
-        shifted = jnp.asarray(chunk - shift, dtype=dtype)
-        return np.asarray(
-            _JIT_JOIN(
-                shifted, cells, chip_index, heavy_cap=hcap, found_cap=fcap
+        dev = jnp.asarray(chunk)
+        if cell_dtype is not None:
+            dev = dev.astype(cell_dtype)
+        if recheck:
+            cells, margins = _assign_cells(
+                index_system, resolution, dev, "margin"
             )
+        else:
+            cells = _assign_cells(index_system, resolution, dev, "cells")
+            margins = None
+        # exact cap sizing from two scalars (pow2-bucketed to bound the
+        # number of distinct compiled programs) — overflow impossible
+        nf, nh = (int(v) for v in np.asarray(_JIT_COUNTS(cells, chip_index)))
+        fcap = min(_next_pow2(nf + 1), chunk.shape[0])
+        hcap = (
+            min(_next_pow2(nh + 1), fcap)
+            if chip_index.num_heavy_cells
+            else None
         )
+        shifted = jnp.asarray(chunk - shift, dtype=dtype)
+        if not recheck:
+            return np.asarray(
+                _JIT_JOIN(
+                    shifted, cells, chip_index, heavy_cap=hcap, found_cap=fcap
+                )
+            )
+
+        # --- epsilon-band recheck (SURVEY §7) -------------------------
+        eps2 = jnp.asarray(
+            (EDGE_BAND_K * float(np.finfo(np.dtype(dtype)).eps)
+             * host.coord_scale) ** 2,
+            dtype=dtype,
+        )
+        out_dev, near = _JIT_JOIN(
+            shifted, cells, chip_index,
+            heavy_cap=hcap, found_cap=fcap, edge_eps2=eps2,
+        )
+        out = np.array(out_dev)  # writable host copies
+        host_mask = np.array(near)  # PIP-boundary band -> host
+        if margins is not None:
+            meps = float(np.finfo(np.dtype(margins.dtype)).eps)
+            km = CELL_MARGIN_K * meps
+            flagged = margins[..., 0] < km
+            n_flag = int(flagged.sum())
+            if n_flag:
+                # borderline cell assignments: re-join against the runner-
+                # up cell on device; only result TIES (plus cell corners
+                # and invalid alternates) escalate to the host oracle
+                cap = min(_next_pow2(n_flag), chunk.shape[0])
+                fidx = jnp.nonzero(flagged, size=cap, fill_value=0)[0]
+                alt = _assign_cells(
+                    index_system, resolution, dev[fidx], "alt"
+                )
+                fidx_np = np.asarray(fidx)[:n_flag]
+                if alt is None:  # system without alternate-rounding
+                    host_mask[fidx_np] = True
+                else:
+                    r_alt = np.asarray(
+                        _JIT_JOIN(
+                            shifted[fidx], alt, chip_index,
+                            heavy_cap=None, found_cap=None,
+                        )
+                    )[:n_flag]
+                    vertex = np.asarray(margins[fidx, 1])[:n_flag] < km
+                    alt_np = np.asarray(alt)[:n_flag]
+                    tie = (
+                        (r_alt != out[fidx_np]) | vertex | (alt_np < 0)
+                    )
+                    host_mask[fidx_np[tie]] = True
+        rows = np.nonzero(host_mask)[0]
+        if rows.size:
+            out[rows] = host_join(chunk[rows], host, index_system, resolution)
+        return out
 
     if batch_size is None or n <= batch_size:
         return run(raw)

@@ -30,24 +30,10 @@ LIVE = os.path.join(REPO, "BENCH_TPU_LIVE.json")
 #: code under test improves during the round)
 REBENCH_S = 3600.0
 
-_PROBE_CODE = """
-import json, sys, time
-t0 = time.time()
-import jax, jax.numpy as jnp
-devs = jax.devices()
-t1 = time.time()
-if devs[0].platform in ("cpu",):
-    print(json.dumps({"platform": "cpu", "devices_s": round(t1 - t0, 2)}))
-    sys.exit(3)
-x = jnp.arange(1024, dtype=jnp.int32)
-r = int(jax.jit(lambda v: ((v * v + 1) ^ (v >> 7)).sum())(x))
-t2 = time.time()
-print(json.dumps({
-    "platform": str(devs[0].platform), "device": str(devs[0]),
-    "devices_s": round(t1 - t0, 2), "compile_run_s": round(t2 - t1, 2),
-}))
-sys.exit(0 if r == int(((x * x + 1) ^ (x >> 7)).sum()) else 4)
-"""
+# the probe snippet lives in bench.py (single source of the round-2
+# lesson: devices() can succeed while compilation hangs)
+sys.path.insert(0, REPO)
+from bench import _PROBE_CODE  # noqa: E402
 
 
 def log(rec: dict) -> None:
@@ -107,7 +93,7 @@ def run_bench() -> bool:
         log({"outcome": f"bench_fail:{e!r}"[:200], "bench_s": round(time.time() - t0, 1)})
         return False
     line.setdefault("detail", {})["bench_wall_s"] = round(time.time() - t0, 1)
-    stamp = time.strftime("%H%M%S")
+    stamp = time.strftime("%m%d_%H%M%S")
     with open(os.path.join(REPO, f"BENCH_TPU_LIVE_{stamp}.json"), "w") as f:
         json.dump(line, f, indent=1)
     ok = bool(line.get("value", 0))
