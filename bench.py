@@ -687,6 +687,42 @@ def main():
         dev_prefix = np.asarray(outs0[0][:nb0])
         detail["numpy_agreement"] = float((base[:nb0] == dev_prefix).mean())
 
+        # single-thread C++ reference-path lane (VERDICT r4 #4): binary-
+        # search equi-join + per-chip `is_core || contains` over clipped
+        # chip rings — the honest JTS-codegen analog this environment can
+        # run. ``vs_baseline`` is measured against THIS lane when the
+        # native library builds (numpy otherwise).
+        base_kind = "numpy"
+        try:
+            from mosaic_tpu.core.geometry.second import (
+                chip_index_csr,
+                eval_pip_join,
+            )
+
+            csr_xy, csr_ro, csr_cro = chip_index_csr(
+                np.asarray(index.border.verts),
+                np.asarray(index.border.ring_len),
+            )
+            nat_args = (
+                csr_xy, csr_ro, csr_cro,
+                np.asarray(index.chip_core), np.asarray(index.chip_geom),
+                np.asarray(index.cells), np.asarray(index.chip_rows),
+                (sub - shift).astype(np.float64), pcells,
+            )
+            native = eval_pip_join(*nat_args)  # warm (may build the .so)
+            t0 = time.perf_counter()
+            native = eval_pip_join(*nat_args)
+            nat_s = time.perf_counter() - t0
+            detail["native_points_per_sec"] = round(n_base / nat_s, 1)
+            detail["native_agreement"] = float(
+                (native[:nb0] == dev_prefix).mean()
+            )
+            base_rate = n_base / nat_s
+            base_kind = "native_cpp_single_thread"
+        except Exception as e:  # missing toolchain: keep the numpy lane
+            detail["native_error"] = repr(e)[:200]
+        detail["baseline_kind"] = base_kind
+
         # f32 cell assignment knowingly trades near-edge points for
         # throughput — quantify the END-TO-END effect every run: same
         # NumPy join fed f64-assigned cells, floor 0.998 on join results

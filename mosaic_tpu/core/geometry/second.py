@@ -59,8 +59,73 @@ def _lib() -> ctypes.CDLL:
             ctypes.POINTER(_c_dpp), ctypes.POINTER(_c_lpp),
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
         ]
+        _c_i32p = ctypes.POINTER(ctypes.c_int32)
+        l.mg_eval_pip_join.restype = ctypes.c_int
+        l.mg_eval_pip_join.argtypes = [
+            _c_dpp, _c_lpp,                      # xy, ro
+            _c_lpp, ctypes.c_int64,              # cro, nchips
+            _c_u8p, _c_i32p,                     # chip_core, chip_geom
+            _c_lpp, ctypes.c_int64,              # cells, ncells
+            _c_i32p, ctypes.c_int64,             # cell_rows, max_chips
+            _c_dpp, _c_lpp, ctypes.c_int64,      # pts, pcells, npts
+            _c_i32p,                             # out
+        ]
         _proto = True
     return l
+
+
+def chip_index_csr(border_verts, ring_len):
+    """CSR rings from a padded chip column for :func:`eval_pip_join`.
+
+    border_verts: (C, R, V, 2); ring_len: (C, R) real vertex counts (the
+    closing vertex is excluded — the C side wraps rings implicitly).
+    Returns (xy (nv, 2) f64-contiguous, ro (nr+1,) i64, cro (C+1,) i64).
+    """
+    bv = np.asarray(border_verts, dtype=np.float64)
+    bl = np.asarray(ring_len)
+    V = bv.shape[2]
+    vmask = np.arange(V)[None, None, :] < bl[:, :, None]  # (C, R, V)
+    xy = np.ascontiguousarray(bv[vmask])  # row-major: chip, ring, vertex
+    rmask = bl > 0
+    ro = np.zeros(int(rmask.sum()) + 1, dtype=np.int64)
+    np.cumsum(bl[rmask], out=ro[1:])
+    cro = np.zeros(bl.shape[0] + 1, dtype=np.int64)
+    np.cumsum(rmask.sum(axis=1), out=cro[1:])
+    return xy, ro, cro
+
+
+def eval_pip_join(xy, ro, cro, chip_core, chip_geom, cells, cell_rows, pts, pcells):
+    """Single-thread C++ reference-shaped PIP join (the bench baseline
+    lane): cell equi-join by binary search + per-chip `is_core ||
+    contains` over clipped chip rings — the closest runnable analog of
+    the reference's JTS codegen row path
+    (`core/geometry/MosaicGeometryJTS.scala:101`)."""
+    lib = _lib()
+    xy = np.ascontiguousarray(xy, dtype=np.float64)
+    ro = np.ascontiguousarray(ro, dtype=np.int64)
+    cro = np.ascontiguousarray(cro, dtype=np.int64)
+    chip_core = np.ascontiguousarray(chip_core, dtype=np.uint8)
+    chip_geom = np.ascontiguousarray(chip_geom, dtype=np.int32)
+    cells = np.ascontiguousarray(cells, dtype=np.int64)
+    cell_rows = np.ascontiguousarray(cell_rows, dtype=np.int32)
+    pts = np.ascontiguousarray(pts, dtype=np.float64)
+    pcells = np.ascontiguousarray(pcells, dtype=np.int64)
+    out = np.empty(pts.shape[0], dtype=np.int32)
+    _c_i32p = ctypes.POINTER(ctypes.c_int32)
+    rc = lib.mg_eval_pip_join(
+        xy.ctypes.data_as(_c_dpp), ro.ctypes.data_as(_c_lpp),
+        cro.ctypes.data_as(_c_lpp), ctypes.c_int64(cro.shape[0] - 1),
+        chip_core.ctypes.data_as(_c_u8p), chip_geom.ctypes.data_as(_c_i32p),
+        cells.ctypes.data_as(_c_lpp), ctypes.c_int64(cells.shape[0]),
+        cell_rows.ctypes.data_as(_c_i32p),
+        ctypes.c_int64(cell_rows.shape[1]),
+        pts.ctypes.data_as(_c_dpp), pcells.ctypes.data_as(_c_lpp),
+        ctypes.c_int64(pts.shape[0]),
+        out.ctypes.data_as(_c_i32p),
+    )
+    if rc != 0:
+        raise RuntimeError(f"mg_eval_pip_join rc={rc}")
+    return out
 
 
 def _geom_contours(col: PackedGeometry, g: int):

@@ -399,6 +399,57 @@ int mg_eval_distance(const double* xy, const int64_t* ro, int64_t nr,
   return 0;
 }
 
+// Single-thread reference-shaped PIP join — the bench's honest baseline
+// lane (the closest runnable analog of the reference's JTS codegen row
+// path, MosaicGeometryJTS.scala:101): binary-search the point's cell in
+// the sorted index, then evaluate the cell's chips exactly the way the
+// reference's generated row code does: `is_core || contains(chip, pt)`
+// on the clipped chip polygon.
+//
+// Chips are CSR rings: chip c owns rings [cro[c], cro[c+1]) of (xy, ro);
+// cell u's chip rows live in cell_rows[u*max_chips ..], -1 padded
+// (trailing). Output: smallest matching geom id, -1 if none.
+int mg_eval_pip_join(const double* xy, const int64_t* ro,
+                     const int64_t* cro, int64_t nchips,
+                     const uint8_t* chip_core, const int32_t* chip_geom,
+                     const int64_t* cells, int64_t ncells,
+                     const int32_t* cell_rows, int64_t max_chips,
+                     const double* pts, const int64_t* pcells, int64_t npts,
+                     int32_t* out) {
+  (void)nchips;
+  for (int64_t i = 0; i < npts; ++i) {
+    int64_t c = pcells[i];
+    int64_t lo = 0, hi = ncells;
+    while (lo < hi) {
+      int64_t mid = (lo + hi) >> 1;
+      if (cells[mid] < c)
+        lo = mid + 1;
+      else
+        hi = mid;
+    }
+    int32_t best = INT32_MAX;
+    if (lo < ncells && cells[lo] == c) {
+      const int32_t* rows = cell_rows + lo * max_chips;
+      double px = pts[2 * i], py = pts[2 * i + 1];
+      for (int64_t m = 0; m < max_chips; ++m) {
+        int32_t chip = rows[m];
+        if (chip < 0) break;
+        int32_t g = chip_geom[chip];
+        if (g >= best) continue;
+        if (chip_core[chip]) {
+          best = g;
+          continue;
+        }
+        int64_t r0 = cro[chip], r1 = cro[chip + 1];
+        if (r1 > r0 && mgeval::evenOddInside(xy, ro + r0, r1 - r0, px, py))
+          best = g;
+      }
+    }
+    out[i] = best == INT32_MAX ? -1 : best;
+  }
+  return 0;
+}
+
 // Independent polygon boolean op (see the block comment above): same ABI
 // and output convention as capi.cpp's mg_bool_op (flat contours, malloc'd,
 // released via mg_free_result); ops 0=inter 1=union 2=diff 3=xor.

@@ -304,3 +304,35 @@ def test_boolean_ops_native_backend_selection(zones):
         d = np.asarray(F.st_area(fn(a, b)))
         n = np.asarray(F.st_area(fn(a, b, backend="native")))
         np.testing.assert_allclose(n, d, rtol=1e-8, atol=1e-12)
+
+
+def test_native_pip_join_matches_f64_oracle():
+    """The single-thread C++ join lane (bench baseline; the JTS-codegen
+    row-path analog) agrees with the exact f64 host oracle."""
+    from mosaic_tpu.core.geometry.second import chip_index_csr, eval_pip_join
+    from mosaic_tpu.core.index import H3
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.sql.join import build_chip_index, host_join
+
+    col = wkt.from_wkt([
+        "POLYGON ((-74.02 40.70, -73.96 40.70, -73.96 40.76, "
+        "-74.02 40.76, -74.02 40.70))",
+        "POLYGON ((-73.96 40.70, -73.90 40.70, -73.90 40.76, "
+        "-73.96 40.76, -73.96 40.70))",
+    ])
+    idx = build_chip_index(tessellate(col, H3, 8, keep_core_geoms=False))
+    rng = np.random.default_rng(1)
+    pts = np.column_stack(
+        [rng.uniform(-74.05, -73.87, 20_000), rng.uniform(40.68, 40.78, 20_000)]
+    )
+    cells = np.asarray(H3.point_to_cell(pts, 8))
+    xy, ro, cro = chip_index_csr(
+        np.asarray(idx.border.verts), np.asarray(idx.border.ring_len)
+    )
+    nat = eval_pip_join(
+        xy, ro, cro, np.asarray(idx.chip_core), np.asarray(idx.chip_geom),
+        np.asarray(idx.cells), np.asarray(idx.chip_rows),
+        pts - idx.host.shift, cells,
+    )
+    truth = host_join(pts, idx.host, H3, 8)
+    np.testing.assert_array_equal(nat, truth)

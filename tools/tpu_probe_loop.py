@@ -31,9 +31,24 @@ LIVE = os.path.join(REPO, "BENCH_TPU_LIVE.json")
 REBENCH_S = 3600.0
 
 # the probe snippet lives in bench.py (single source of the round-2
-# lesson: devices() can succeed while compilation hangs)
+# lesson: devices() can succeed while compilation hangs) — but the
+# watchdog must keep probing even while bench.py is mid-edit and broken,
+# so a minimal self-contained fallback covers import failure
 sys.path.insert(0, REPO)
-from bench import _PROBE_CODE  # noqa: E402
+try:
+    from bench import _PROBE_CODE
+except Exception:  # noqa: BLE001 — any bench.py breakage, keep watching
+    _PROBE_CODE = """
+import json, sys
+import jax, jax.numpy as jnp
+devs = jax.devices()
+if devs[0].platform in ("cpu",):
+    sys.exit(3)
+x = jnp.arange(1024, dtype=jnp.int32)
+r = int(jax.jit(lambda v: ((v * v + 1) ^ (v >> 7)).sum())(x))
+print(json.dumps({"platform": str(devs[0].platform), "device": str(devs[0])}))
+sys.exit(0 if r == int(((x * x + 1) ^ (x >> 7)).sum()) else 4)
+"""
 
 
 def log(rec: dict) -> None:
