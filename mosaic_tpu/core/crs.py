@@ -791,6 +791,209 @@ def stere_polar_inverse(p, en, xp=np):
     return xp.stack([lon, lat], axis=-1)
 
 
+def _marc(a, e2, phi, xp):
+    """Meridian arc length from the equator (Snyder 3-21)."""
+    e4 = e2 * e2
+    e6 = e4 * e2
+    return a * (
+        (1 - e2 / 4 - 3 * e4 / 64 - 5 * e6 / 256) * phi
+        - (3 * e2 / 8 + 3 * e4 / 32 + 45 * e6 / 1024) * xp.sin(2 * phi)
+        + (15 * e4 / 256 + 45 * e6 / 1024) * xp.sin(4 * phi)
+        - (35 * e6 / 3072) * xp.sin(6 * phi)
+    )
+
+
+def _marc_inverse(a, e2, M, xp):
+    """Footpoint latitude from a meridian arc (Snyder 3-26, closed series)."""
+    mu = M / (a * (1 - e2 / 4 - 3 * e2 * e2 / 64 - 5 * e2**3 / 256))
+    se = math.sqrt(1 - e2)
+    e1 = (1 - se) / (1 + se)
+    return (
+        mu
+        + (3 * e1 / 2 - 27 * e1**3 / 32) * xp.sin(2 * mu)
+        + (21 * e1**2 / 16 - 55 * e1**4 / 32) * xp.sin(4 * mu)
+        + (151 * e1**3 / 96) * xp.sin(6 * mu)
+        + (1097 * e1**4 / 512) * xp.sin(8 * mu)
+    )
+
+
+def cass_forward(p, lonlat, xp=np):
+    """Cassini-Soldner (EPSG method 9806, Snyder 95)."""
+    a, e, lat0, lon0, fe, fn = p
+    e2 = e * e
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    s, c = xp.sin(lat), xp.cos(lat)
+    t = xp.tan(lat)
+    T = t * t
+    nu = a / xp.sqrt(1 - e2 * s * s)
+    A = (lon - lon0) * c
+    C = e2 * c * c / (1 - e2)
+    M = _marc(a, e2, lat, xp)
+    M0 = _marc(a, e2, np.asarray(lat0), np)
+    x = nu * (A - T * A**3 / 6 - (8 - T + 8 * C) * T * A**5 / 120)
+    y = (
+        M - M0
+        + nu * t * (A * A / 2 + (5 - T + 6 * C) * A**4 / 24)
+    )
+    return xp.stack([fe + x, fn + y], axis=-1)
+
+
+def cass_inverse(p, en, xp=np):
+    a, e, lat0, lon0, fe, fn = p
+    e2 = e * e
+    x = en[..., 0] - fe
+    y = en[..., 1] - fn
+    M0 = _marc(a, e2, np.asarray(lat0), np)
+    phi1 = _marc_inverse(a, e2, M0 + y, xp)
+    s1 = xp.sin(phi1)
+    t1 = xp.tan(phi1)
+    T1 = t1 * t1
+    nu1 = a / xp.sqrt(1 - e2 * s1 * s1)
+    rho1 = a * (1 - e2) * (1 - e2 * s1 * s1) ** -1.5
+    D = x / nu1
+    lat = phi1 - (nu1 * t1 / rho1) * (
+        D * D / 2 - (1 + 3 * T1) * D**4 / 24
+    )
+    lon = lon0 + (
+        D - T1 * D**3 / 3 + (1 + 3 * T1) * T1 * D**5 / 15
+    ) / xp.cos(phi1)
+    return xp.stack([lon, lat], axis=-1)
+
+
+def _eqdc_consts(p):
+    a, e, lat0, lon0, lat1, lat2, fe, fn = p
+    e2 = e * e
+
+    def m(phi):
+        return math.cos(phi) / math.sqrt(1 - e2 * math.sin(phi) ** 2)
+
+    m1, m2 = m(lat1), m(lat2)
+    M0 = float(_marc(a, e2, np.asarray(lat0), np))
+    M1 = float(_marc(a, e2, np.asarray(lat1), np))
+    M2 = float(_marc(a, e2, np.asarray(lat2), np))
+    if abs(lat1 - lat2) < 1e-12:
+        n = math.sin(lat1)
+    else:
+        n = a * (m1 - m2) / (M2 - M1)
+    G = m1 / n + M1 / a
+    rho0 = a * G - M0
+    return n, G, rho0
+
+
+def eqdc_forward(p, lonlat, xp=np):
+    """Equidistant conic, ellipsoidal (Snyder 111-115)."""
+    a, e, lat0, lon0, lat1, lat2, fe, fn = p
+    n, G, rho0 = _eqdc_consts(p)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    rho = a * G - _marc(a, e * e, lat, xp)
+    theta = n * (lon - lon0)
+    x = rho * xp.sin(theta)
+    y = rho0 - rho * xp.cos(theta)
+    return xp.stack([fe + x, fn + y], axis=-1)
+
+
+def eqdc_inverse(p, en, xp=np):
+    a, e, lat0, lon0, lat1, lat2, fe, fn = p
+    n, G, rho0 = _eqdc_consts(p)
+    x = en[..., 0] - fe
+    y = rho0 - (en[..., 1] - fn)
+    sgn = 1.0 if n >= 0 else -1.0
+    rho = sgn * xp.sqrt(x * x + y * y)
+    theta = xp.arctan2(sgn * x, sgn * y)
+    lat = _marc_inverse(a, e * e, a * G - rho, xp)
+    lon = lon0 + theta / n
+    return xp.stack([lon, lat], axis=-1)
+
+
+def _omerc_consts(p):
+    """Hotine oblique Mercator shared constants (EPSG 9812/9815)."""
+    a, e, lat0, lonc, alpha_c, gamma_c, k0, fe, fn, variant = p
+    e2 = e * e
+    s0, c0 = math.sin(lat0), math.cos(lat0)
+    B = math.sqrt(1 + e2 * c0**4 / (1 - e2))
+    A = a * B * k0 * math.sqrt(1 - e2) / (1 - e2 * s0 * s0)
+    t0 = math.tan(math.pi / 4 - lat0 / 2) / (
+        (1 - e * s0) / (1 + e * s0)
+    ) ** (e / 2)
+    D = B * math.sqrt(1 - e2) / (c0 * math.sqrt(1 - e2 * s0 * s0))
+    D2 = max(D * D, 1.0)
+    sgn = 1.0 if lat0 >= 0 else -1.0
+    F = D + math.sqrt(D2 - 1.0) * sgn
+    H = F * t0**B
+    G = (F - 1.0 / F) / 2.0
+    gamma0 = math.asin(math.sin(alpha_c) / D)
+    lam0 = lonc - math.asin(G * math.tan(gamma0)) / B
+    uc = 0.0
+    if variant == "B":
+        if abs(alpha_c - math.pi / 2) < 1e-12:
+            uc = A * (lonc - lam0)
+        else:
+            uc = (A / B) * math.atan2(
+                math.sqrt(D2 - 1.0), math.cos(alpha_c)
+            ) * sgn
+    return A, B, H, gamma0, lam0, uc
+
+
+def omerc_forward(p, lonlat, xp=np):
+    """Hotine oblique Mercator (EPSG 9812 variant A / 9815 variant B).
+
+    Reference analog: proj4j's omerc for the RSO/Alaska grids the
+    reference resolves through its registry
+    (`core/geometry/MosaicGeometry.scala:102-128`). Validated against the
+    EPSG Guidance Note 7-2 worked example (Timbalai 1948 / RSO Borneo)."""
+    a, e, lat0, lonc, alpha_c, gamma_c, k0, fe, fn, variant = p
+    A, B, H, gamma0, lam0, uc = _omerc_consts(p)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    t = _ts_fn(lat, e, xp)
+    Q = H / t**B
+    S = (Q - 1.0 / Q) / 2.0
+    T = (Q + 1.0 / Q) / 2.0
+    dl = B * (lon - lam0)
+    V = xp.sin(dl)
+    U = (-V * math.cos(gamma0) + S * math.sin(gamma0)) / T
+    v = A * xp.log((1 - U) / (1 + U)) / (2.0 * B)
+    u = A * xp.arctan2(
+        S * math.cos(gamma0) + V * math.sin(gamma0), xp.cos(dl)
+    ) / B
+    u = u - uc  # 0 for variant A
+    cg, sg = math.cos(gamma_c), math.sin(gamma_c)
+    x = v * cg + u * sg
+    y = u * cg - v * sg
+    return xp.stack([fe + x, fn + y], axis=-1)
+
+
+def omerc_inverse(p, en, xp=np):
+    a, e, lat0, lonc, alpha_c, gamma_c, k0, fe, fn, variant = p
+    A, B, H, gamma0, lam0, uc = _omerc_consts(p)
+    cg, sg = math.cos(gamma_c), math.sin(gamma_c)
+    x = en[..., 0] - fe
+    y = en[..., 1] - fn
+    v = x * cg - y * sg
+    u = y * cg + x * sg + uc
+    Q = xp.exp(-B * v / A)
+    S = (Q - 1.0 / Q) / 2.0
+    T = (Q + 1.0 / Q) / 2.0
+    du = B * u / A
+    V = xp.sin(du)
+    U = (V * math.cos(gamma0) + S * math.sin(gamma0)) / T
+    t = (H / xp.sqrt((1 + U) / (1 - U))) ** (1.0 / B)
+    lat = _phi_from_ts(t, e, xp)
+    lon = lam0 - xp.arctan2(
+        S * math.cos(gamma0) - V * math.sin(gamma0), xp.cos(du)
+    ) / B
+    return xp.stack([lon, lat], axis=-1)
+
+
+def tm_south_forward(p: TMParams, lonlat, xp=np):
+    """Transverse Mercator South Orientated (EPSG method 9808, the South
+    African Lo grids): westing/southing — the TM axes negated."""
+    return -tm_forward(p, lonlat, xp)
+
+
+def tm_south_inverse(p: TMParams, en, xp=np):
+    return tm_inverse(p, -en, xp)
+
+
 # --------------------------------------------------------------------------
 # projected-CRS registry
 # --------------------------------------------------------------------------
@@ -1091,6 +1294,10 @@ _FAMILY_FNS = {
     "krovak": (krovak_forward, krovak_inverse),
     "poly": (poly_forward, poly_inverse),
     "merc": (merc_forward, merc_inverse),
+    "cass": (cass_forward, cass_inverse),
+    "eqdc": (eqdc_forward, eqdc_inverse),
+    "omerc": (omerc_forward, omerc_inverse),
+    "tm_south": (tm_south_forward, tm_south_inverse),
 }
 
 

@@ -31,8 +31,16 @@ from .crs import (
     _ecef_to_geodetic,
     _geodetic_to_ecef,
     _helmert,
+    cass_forward,
+    cass_inverse,
+    eqdc_forward,
+    eqdc_inverse,
     laea_forward,
     laea_inverse,
+    omerc_forward,
+    omerc_inverse,
+    tm_south_forward,
+    tm_south_inverse,
     lcc2sp_forward,
     lcc2sp_inverse,
     albers_forward,
@@ -94,8 +102,9 @@ UNITS: dict[str, float] = {
 }
 
 _SUPPORTED_PROJ = (
-    "utm, tmerc, merc, lcc, aea, laea, stere (polar), sterea, somerc, "
-    "krovak, poly, longlat/latlong"
+    "utm, tmerc (incl. +axis=wsu south-orientated), merc, lcc, aea, eqdc, "
+    "laea, stere (polar), sterea, somerc, omerc (Hotine A/B), krovak, "
+    "cass, poly, longlat/latlong"
 )
 
 
@@ -190,7 +199,7 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
     """Parse a PROJ.4 string into a :class:`ProjCRS`.
 
     Supported projections: {supported}. Raises ``ValueError`` with the
-    supported list for anything else (eqdc, cass, ...).
+    supported list for anything else (robin, tpeqd, ...).
     """
     kv = _parse_tokens(s)
     proj = kv.get("proj")
@@ -229,11 +238,16 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
         )
         return ProjCRS("tm", p, a, e2, shift, to_meter, area)
     if proj == "tmerc":
+        axis = kv.get("axis", "enu")
+        if axis not in ("enu", "wsu"):
+            raise ValueError(f"+axis={axis} unsupported for tmerc")
         p = TMParams(
             a=a, b=b, f0=k0 if k0 is not None else 1.0,
             lat0=lat0, lon0=lon0, e0=fe, n0=fn,
         )
-        return ProjCRS("tm", p, a, e2, shift, to_meter, area)
+        # +axis=wsu = EPSG 9808 TM South Orientated (South African Lo)
+        kind = "tm_south" if axis == "wsu" else "tm"
+        return ProjCRS(kind, p, a, e2, shift, to_meter, area)
     if proj == "merc":
         if k0 is None:
             lat_ts = _f(kv, "lat_ts", 0.0)
@@ -263,6 +277,33 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
         lat2 = _f(kv, "lat_2", lat1)
         p = (a, e, lat0, lon0, _R(lat1), _R(lat2), fe, fn)
         return ProjCRS("albers", p, a, e2, shift, to_meter, area)
+    if proj == "eqdc":
+        lat1 = _f(kv, "lat_1", 0.0)
+        lat2 = _f(kv, "lat_2", lat1)
+        if abs(lat1 + lat2) < 1e-9:  # n = 0: the cone degenerates
+            raise ValueError(
+                "+proj=eqdc standard parallels must not be symmetric "
+                f"about the equator (lat_1={lat1}, lat_2={lat2})"
+            )
+        p = (a, e, lat0, lon0, _R(lat1), _R(lat2), fe, fn)
+        return ProjCRS("eqdc", p, a, e2, shift, to_meter, area)
+    if proj == "cass":
+        p = (a, e, lat0, lon0, fe, fn)
+        return ProjCRS("cass", p, a, e2, shift, to_meter, area)
+    if proj == "omerc":
+        lonc = _R(_f(kv, "lonc", math.degrees(lon0)))
+        alpha = _f(kv, "alpha")
+        if alpha is None:
+            raise ValueError(
+                "+proj=omerc needs +alpha (two-point form unsupported)"
+            )
+        gamma = _f(kv, "gamma", alpha)
+        variant = "A" if kv.get("no_uoff") else "B"
+        p = (
+            a, e, lat0, lonc, _R(alpha), _R(gamma),
+            k0 if k0 is not None else 1.0, fe, fn, variant,
+        )
+        return ProjCRS("omerc", p, a, e2, shift, to_meter, area)
     if proj == "laea":
         return ProjCRS(
             "laea", (a, e, lat0, lon0, fe, fn), a, e2, shift, to_meter, area
@@ -311,6 +352,10 @@ parse_proj.__doc__ = parse_proj.__doc__.format(supported=_SUPPORTED_PROJ)
 
 
 _FWD = {
+    "cass": cass_forward,
+    "eqdc": eqdc_forward,
+    "omerc": omerc_forward,
+    "tm_south": tm_south_forward,
     "tm": tm_forward,
     "lcc2sp": lcc2sp_forward,
     "albers": albers_forward,
@@ -323,6 +368,10 @@ _FWD = {
     "merc": merc_forward,
 }
 _INV = {
+    "cass": cass_inverse,
+    "eqdc": eqdc_inverse,
+    "omerc": omerc_inverse,
+    "tm_south": tm_south_inverse,
     "tm": tm_inverse,
     "lcc2sp": lcc2sp_inverse,
     "albers": albers_inverse,
@@ -386,10 +435,18 @@ def default_area(crs: ProjCRS) -> tuple[float, float, float, float]:
         return (-180.0, -90.0, 180.0, 90.0)
     if crs.kind == "merc":
         return (-180.0, -85.06, 180.0, 85.06)
-    if crs.kind == "tm":
+    if crs.kind in ("tm", "tm_south"):
         lon0 = math.degrees(crs.params.lon0)
         return (lon0 - 3.5, -80.0, lon0 + 3.5, 84.0)
-    if crs.kind in ("lcc2sp", "albers"):
+    if crs.kind == "cass":
+        _, _, lat0, lon0, _, _ = crs.params
+        lat0, lon0 = math.degrees(lat0), math.degrees(lon0)
+        return (lon0 - 3.0, max(lat0 - 4.0, -89.0), lon0 + 3.0, min(lat0 + 4.0, 89.0))
+    if crs.kind == "omerc":
+        _, _, lat0, lonc, _, _, _, _, _, _ = crs.params
+        lat0, lonc = math.degrees(lat0), math.degrees(lonc)
+        return (lonc - 8.0, max(lat0 - 8.0, -89.0), lonc + 8.0, min(lat0 + 8.0, 89.0))
+    if crs.kind in ("lcc2sp", "albers", "eqdc"):
         _, _, _, lon0, lat1, lat2, _, _ = crs.params
         lo = min(math.degrees(lat1), math.degrees(lat2)) - 10.0
         hi = max(math.degrees(lat1), math.degrees(lat2)) + 10.0
@@ -570,7 +627,71 @@ _EPSG: dict[int, tuple[str, tuple[float, float, float, float]]] = {
     # geographic CRSs on non-WGS84 datums
     4277: ("+proj=longlat +datum=OSGB36", (-9.0, 49.75, 2.01, 61.01)),
     4314: ("+proj=longlat +datum=potsdam", (5.86, 47.27, 15.04, 55.09)),
+    # ---- Hotine oblique Mercator (EPSG 9812 variant A / 9815 variant B)
+    # NAD83 / Alaska zone 1 (variant A: +no_uoff)
+    26931: (
+        "+proj=omerc +lat_0=57 +lonc=-133.6666666666667 "
+        "+alpha=323.1301023611111 +gamma=323.1301023611111 +k=0.9999 "
+        "+x_0=5000000 +y_0=-5000000 +no_uoff " + _GRS,
+        (-141.0, 54.61, -129.99, 60.35),
+    ),
+    # GDM2000 / Peninsular RSO (variant B, rectified skew != azimuth)
+    3375: (
+        "+proj=omerc +lat_0=4 +lonc=102.25 +alpha=323.0257964666666 "
+        "+gamma=323.1301023611111 +k=0.99984 +x_0=804671 +y_0=0 " + _GRS,
+        (99.59, 1.13, 104.60, 6.72),
+    ),
+    # GDM2000 / East Malaysia BRSO (variant B)
+    3376: (
+        "+proj=omerc +lat_0=4 +lonc=115 +alpha=53.31582047222222 "
+        "+gamma=53.13010236111111 +k=0.99984 +x_0=0 +y_0=0 " + _GRS,
+        (109.31, 0.85, 119.61, 7.67),
+    ),
+    # Timbalai 1948 / RSO Borneo (m) — the EPSG G7-2 worked example
+    29873: (
+        "+proj=omerc +lat_0=4 +lonc=115 +alpha=53.31582047222222 "
+        "+gamma=53.13010236111111 +k=0.99984 +x_0=590476.87 "
+        "+y_0=442857.65 +a=6377298.556 +rf=300.8017 +towgs84=-679,669,-48",
+        (109.55, 0.85, 115.86, 7.35),
+    ),
+    # ---- Cassini-Soldner (EPSG 9806)
+    # Palestine 1923 / Palestine Grid (Clarke 1880 Benoit)
+    28191: (
+        "+proj=cass +lat_0=31.73409694444445 +lon_0=35.21208055555556 "
+        "+x_0=170251.555 +y_0=126867.909 +a=6378300.789 +b=6356566.435 "
+        "+towgs84=-275.722,94.7824,340.894,-8.001,-4.42,-11.821,1",
+        (34.17, 29.18, 35.69, 33.38),
+    ),
+    # Kertau 1968 / Singapore Grid (Everest 1830 Modified)
+    24500: (
+        "+proj=cass +lat_0=1.287646666666667 +lon_0=103.8530022222222 "
+        "+x_0=30000 +y_0=30000 +a=6377304.063 +b=6356103.038993155 "
+        "+towgs84=-11,851,5",
+        (103.59, 1.13, 104.07, 1.47),
+    ),
+    # ---- Equidistant conic (ESRI registry ids — the codes this family
+    # actually travels under in the wild; resolvable like any EPSG int)
+    102031: (
+        "+proj=eqdc +lat_0=30 +lon_0=10 +lat_1=43 +lat_2=62 +x_0=0 +y_0=0 "
+        "+towgs84=-87,-98,-121 +ellps=intl",
+        (-10.67, 34.5, 31.55, 71.05),
+    ),
+    102026: (
+        "+proj=eqdc +lat_0=30 +lon_0=95 +lat_1=15 +lat_2=65 +x_0=0 +y_0=0 "
+        "+ellps=WGS84",
+        (25.0, 10.0, 180.0, 84.0),
+    ),
 }
+
+# Hartebeesthoek94 / Lo15..Lo33 (EPSG 2046..2055): south-orientated TM
+# (EPSG method 9808) — westing/southing axes via +axis=wsu
+for _z in range(10):
+    _lo = 15 + 2 * _z
+    _EPSG[2046 + _z] = (
+        f"+proj=tmerc +lat_0=0 +lon_0={_lo} +k=1 +x_0=0 +y_0=0 "
+        "+axis=wsu +ellps=WGS84",
+        (_lo - 1.1, -34.9, _lo + 1.1, -22.1),
+    )
 
 # DHDN / 3-degree Gauss-Krueger zones 2..5 (Germany); zone 2 carries its
 # published per-zone extent (west Germany only), the rest approximate

@@ -20,6 +20,13 @@ def try_sql(fn: Callable, *columns, **kwargs):
     wraps one expression per query; here any row-wise callable works:
 
     >>> res, err = try_sql(lambda w: st_area([w])[0], wkts)
+
+    This is deliberately a per-row Python loop — a compatibility shim
+    matching the reference's per-row TrySql semantics, NOT a columnar
+    fast path: per-row exception isolation is the feature, and it costs
+    a Python-level call per row. On clean million-row columns call the
+    columnar function directly and use try_sql only to triage the rows
+    that failed.
     """
     n = len(columns[0])
     results: list = [None] * n

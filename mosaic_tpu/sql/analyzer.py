@@ -38,17 +38,23 @@ class MosaicAnalyzer:
         self.index = index
         self.target_cells = target_cells
 
-    def _geometry_areas(self, col, sample: SampleStrategy, seed: int) -> np.ndarray:
+    def _sampled(self, col, sample: SampleStrategy, seed: int):
+        """(sampled PackedGeometry, finite positive areas) — the shared
+        sampling/area/filter step of every analyzer entry point."""
         packed = to_packed(col)
         rng = np.random.default_rng(seed)
         rows = sample.apply(len(packed), rng)
         from ..core.geometry import oracle
 
-        areas = oracle.area(packed)[rows]
+        sub = packed.take(rows)
+        areas = oracle.area(sub)
         areas = areas[np.isfinite(areas) & (areas > 0)]
         if areas.size == 0:
             raise ValueError("no polygonal geometries to analyze")
-        return areas
+        return sub, areas
+
+    def _geometry_areas(self, col, sample: SampleStrategy, seed: int) -> np.ndarray:
+        return self._sampled(col, sample, seed)[1]
 
     def get_optimal_resolution(
         self,
@@ -75,6 +81,56 @@ class MosaicAnalyzer:
         if best is None:
             raise ValueError("index system exposes no cell areas")
         return int(best)
+
+    def get_optimal_resolution_reference(
+        self,
+        col,
+        sample: "SampleStrategy | None" = None,
+        lower: float = 1.0,
+        upper: float = 100.0,
+        seed: int = 0,
+    ) -> int:
+        """The reference's exact recipe (`MosaicAnalyzer.scala:28-39` +
+        `:41-100`): per resolution, the mean cell area is measured from
+        the boundary polygon of the cell containing each geometry's
+        centroid; resolutions where ANY of the mean/p25/p50/p75
+        cells-per-geometry ratios fall inside (lower, upper) survive, and
+        the median-by-p50-ratio row wins. Golden-pinned on the NYC taxi
+        fixture in tests/test_models_services.py (resolution 9)."""
+        sample = sample or SampleStrategy()
+        sub, areas = self._sampled(col, sample, seed)
+        from ..core.geometry import oracle
+
+        stats = (
+            float(areas.mean()),
+            *(float(v) for v in np.percentile(areas, [25, 50, 75])),
+        )
+        cents = oracle.centroid(sub)
+        cents = cents[np.isfinite(cents).all(axis=1)]
+        kept: list[tuple[float, int]] = []
+        for res in self.index.resolutions():
+            cells = np.asarray(self.index.point_to_cell(cents, res))
+            bnd = np.asarray(self.index.cell_boundary(cells))
+            x, y = bnd[..., 0], bnd[..., 1]
+            a = 0.5 * np.abs(
+                np.sum(
+                    x * np.roll(y, -1, axis=-1) - np.roll(x, -1, axis=-1) * y,
+                    axis=-1,
+                )
+            )
+            ia = float(a.mean())
+            if ia <= 0:
+                continue
+            ratios = [s / ia for s in stats]
+            if any(lower < r < upper for r in ratios):
+                kept.append((ratios[2], int(res)))
+        if not kept:
+            raise ValueError(
+                "no resolution has cells-per-geometry inside "
+                f"({lower}, {upper})"
+            )
+        kept.sort()
+        return kept[(len(kept) - 1) // 2][1]
 
     def get_resolution_metrics(
         self,

@@ -37,13 +37,18 @@ _CSV_ROWS = {
     32118: (277102.1637, 33718.9600, 490794.6230, 129387.2653),
 }
 
-_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [28355, 31983, 7855, 31970, 3395, 3435, 21781, 5514, 5880]
+_ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [
+    28355, 31983, 7855, 31970, 3395, 3435, 21781, 5514, 5880,
+    # round-5 families: omerc A/B, cass, eqdc, south-orientated tmerc
+    26931, 3375, 3376, 29873, 28191, 24500, 102031, 102026, 2048, 2053,
+]
 
 
 def _interior_grid(srid, n=7, margin=0.25):
     x0, y0, x1, y1 = crs.crs_bounds(srid, reprojected=False)
-    xs = np.linspace(x0 + margin, x1 - margin, n)
-    ys = np.linspace(y0 + margin, y1 - margin, n)
+    m = min(margin, (x1 - x0) / 5, (y1 - y0) / 5)  # tiny areas (Singapore)
+    xs = np.linspace(x0 + m, x1 - m, n)
+    ys = np.linspace(y0 + m, y1 - m, n)
     return np.stack(np.meshgrid(xs, ys), -1).reshape(-1, 2)
 
 
@@ -321,7 +326,7 @@ def test_oblique_projections_are_conformal(srid):
 
 def test_parse_errors_are_loud():
     with pytest.raises(ValueError, match="implemented families"):
-        parse_proj("+proj=eqdc +lat_1=20 +lat_2=60")
+        parse_proj("+proj=robin +lon_0=0")
     with pytest.raises(ValueError, match="prime meridian"):
         parse_proj("+proj=lcc +lat_1=49 +lat_2=44 +pm=paris")
     with pytest.raises(ValueError, match="towgs84"):
@@ -367,6 +372,83 @@ def test_polyconic_inverse_under_jit():
         jnp.asarray(en)
     )
     assert np.abs(np.asarray(got) - want).max() < 1e-5
+
+
+def test_omerc_epsg_worked_example():
+    """EPSG Guidance Note 7-2 worked example for Hotine oblique Mercator
+    variant B: Timbalai 1948 / RSO Borneo (m). The projected coordinates
+    must reproduce to centimetres (reference: proj4j resolves 29873
+    through the same registry parameters)."""
+    import math
+
+    from mosaic_tpu.core.crs import omerc_forward
+
+    a, rf = 6377298.556, 300.8017
+    f = 1 / rf
+    e = math.sqrt(f * (2 - f))
+    d = math.radians
+    p = (
+        a, e, d(4.0), d(115.0),
+        d(53 + 18 / 60 + 56.9537 / 3600),  # azimuth alpha_c
+        d(53 + 7 / 60 + 48.3685 / 3600),   # rectified grid angle gamma_c
+        0.99984, 590476.87, 442857.65, "B",
+    )
+    lat = d(5 + 23 / 60 + 14.1129 / 3600)
+    lon = d(115 + 48 / 60 + 19.8196 / 3600)
+    en = omerc_forward(p, np.array([[lon, lat]]))
+    np.testing.assert_allclose(en[0], [679245.73, 596562.78], atol=0.02)
+
+
+def test_omerc_variant_a_differs_from_b():
+    # +no_uoff (variant A) shifts the grid by u_c along the skew axis
+    va = parse_proj(
+        "+proj=omerc +lat_0=4 +lonc=115 +alpha=53.31582047222222 "
+        "+gamma=53.13010236111111 +k=0.99984 +no_uoff +ellps=GRS80"
+    )
+    vb = parse_proj(
+        "+proj=omerc +lat_0=4 +lonc=115 +alpha=53.31582047222222 "
+        "+gamma=53.13010236111111 +k=0.99984 +ellps=GRS80"
+    )
+    from mosaic_tpu.core.crs_proj import crs_from_wgs84
+
+    pt = np.array([[115.0, 4.0]])
+    ea = crs_from_wgs84(va, pt)
+    eb = crs_from_wgs84(vb, pt)
+    assert np.abs(ea - eb).max() > 1000.0  # u_c is hundreds of km here
+    # each variant round-trips on its own
+    from mosaic_tpu.core.crs_proj import crs_to_wgs84
+
+    for v, en in ((va, ea), (vb, eb)):
+        np.testing.assert_allclose(crs_to_wgs84(v, en), pt, atol=1e-9)
+
+
+def test_tm_south_orientation():
+    """Lo grids: westing grows west, southing grows south (EPSG 9808)."""
+    en = crs.from_wgs84(np.array([[18.5, -33.9]]), 2048)  # west+south of L019 origin
+    assert en[0, 0] > 0 and en[0, 1] > 0
+    east = crs.from_wgs84(np.array([[19.5, -33.9]]), 2048)
+    assert east[0, 0] < 0  # east of lon_0 -> negative westing
+
+
+def test_eqdc_distance_property():
+    """Equidistant conic: meridian arcs project with true length."""
+    p = parse_proj(
+        "+proj=eqdc +lat_0=30 +lon_0=95 +lat_1=15 +lat_2=65 +ellps=WGS84"
+    )
+    from mosaic_tpu.core.crs_proj import crs_from_wgs84
+
+    lats = np.linspace(20.0, 60.0, 41)
+    ll = np.stack([np.full_like(lats, 95.0), lats], -1)
+    en = crs_from_wgs84(p, ll)
+    seg = np.hypot(np.diff(en[:, 0]), np.diff(en[:, 1])).sum()
+    from mosaic_tpu.core.crs import _marc
+
+    e2 = 0.00669437999014132
+    arc = float(
+        _marc(6378137.0, e2, np.radians(60.0), np)
+        - _marc(6378137.0, e2, np.radians(20.0), np)
+    )
+    assert abs(seg - arc) / arc < 1e-6
 
 
 def test_datum_shift_geographic_crs():

@@ -79,6 +79,27 @@ def test_analyzer_resolution():
     assert MosaicAnalyzer(idx).get_optimal_resolution(zones, sample=s) in idx.resolutions()
 
 
+def test_analyzer_reference_golden_nyc():
+    """The reference-recipe analyzer pinned to the resolution the
+    reference's `MosaicAnalyzer.getOptimalResolution` yields on its own
+    NYC taxi-zone fixture (hand-derived from `MosaicAnalyzer.scala:28-39`:
+    surviving band rows are res 8/9/10 with p50 cells-per-geometry ratios
+    1.91 / 13.3 / 93.4; the median-by-p50 row is resolution 9)."""
+    import os
+
+    import pytest
+
+    fixture = "/root/reference/src/test/resources/NYC_Taxi_Zones.geojson"
+    if not os.path.exists(fixture):
+        pytest.skip("reference NYC fixture unavailable")
+    from mosaic_tpu.readers.vector import read_geojson
+
+    zones = read_geojson(fixture).geometry
+    idx = H3IndexSystem()
+    got = MosaicAnalyzer(idx).get_optimal_resolution_reference(zones)
+    assert got == 9
+
+
 # -------------------------------------------------------------- MosaicFrame
 
 
