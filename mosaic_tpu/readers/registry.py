@@ -116,6 +116,18 @@ def _fmt_gml(path, **kw):
     return read_gml(path, srid=int(kw.get("srid", 4326)))
 
 
+def _fmt_mif(path, **kw):
+    from .mif import read_mif
+
+    return read_mif(path)
+
+
+def _fmt_dxf(path, **kw):
+    from .dxf import read_dxf
+
+    return read_dxf(path)
+
+
 def _fmt_gpx(path, **kw):
     from .gml import read_gpx
 
@@ -137,6 +149,9 @@ _FORMATS: dict[str, Callable] = {
     "zarr": _fmt_zarr,
     "raster_to_grid": _fmt_raster_to_grid,
     "csv_points": _fmt_csv_points,
+    "mapinfo": _fmt_mif,  # OGR "MapInfo File" driver name analog
+    "mif": _fmt_mif,
+    "dxf": _fmt_dxf,
 }
 
 

@@ -336,4 +336,16 @@ def open_any(path: str) -> VectorTable:
         from .gml import read_gpx
 
         return read_gpx(path)
+    if s.endswith(".mif"):
+        from .mif import read_mif
+
+        return read_mif(path)
+    if s.endswith(".dxf"):
+        from .dxf import read_dxf
+
+        return read_dxf(path)
+    if s.endswith(".gpkg"):
+        from .geopackage import read_geopackage
+
+        return read_geopackage(path)
     raise ValueError(f"no reader for {path}")

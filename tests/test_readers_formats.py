@@ -625,3 +625,163 @@ def test_gml_3d_poslist_without_srsdimension(tmp_path):
     assert g.geometry_type(0) == GeometryType.LINESTRING
     np.testing.assert_allclose(g.geom_xy(0), [[0, 0], [1, 1], [2, 0]])
     assert g.has_z(0)
+
+
+def test_mif_reader(tmp_path):
+    """MapInfo MIF/MID: points, lines, multi-section plines, and a holed
+    region (MIF marks no holes — nesting is resolved by containment)."""
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.registry import read
+
+    mif = """VERSION 300
+Charset "WindowsLatin1"
+DELIMITER ","
+COLUMNS 2
+  name Char(20)
+  val Decimal(10,2)
+DATA
+POINT 10 20
+  SYMBOL (34,0,12)
+LINE 0 0 5 5
+PLINE 3
+0 0
+2 2
+4 0
+  PEN (1,2,0)
+REGION 2
+  5
+0 0
+10 0
+10 10
+0 10
+0 0
+  4
+2 2
+2 4
+4 2
+2 2
+  BRUSH (2,16777215,16777215)
+PLINE MULTIPLE 2
+2
+0 0
+1 1
+2
+5 5
+6 6
+"""
+    mid = '"zoneA",1.50\n"zoneB",2\n"zoneC",3\n"zoneD",4.25\n"zoneE",5\n'
+    (tmp_path / "t.mif").write_text(mif)
+    (tmp_path / "t.mid").write_text(mid)
+    t = read("mapinfo").load(tmp_path / "t.mif")
+    assert len(t) == 5
+    g = t.geometry
+    assert g.geometry_type(0) == GeometryType.POINT
+    np.testing.assert_allclose(g.geom_xy(0), [[10, 20]])
+    assert g.geometry_type(1) == GeometryType.LINESTRING
+    assert g.geometry_type(2) == GeometryType.LINESTRING
+    assert g.geom_xy(2).shape[0] == 3
+    # region: outer shell + contained hole
+    assert g.geometry_type(3) == GeometryType.POLYGON
+    from mosaic_tpu import functions as F
+
+    area = float(np.asarray(F.st_area(t.geometry.take([3])))[0])
+    assert abs(area - (100.0 - 2.0)) < 1e-9  # hole area 2 removed
+    assert g.geometry_type(4) == GeometryType.MULTILINESTRING
+    assert t.columns["name"][3] == "zoneD"
+    assert t.columns["val"][3] == 4.25
+
+
+def test_dxf_reader(tmp_path):
+    """DXF entities: POINT, LINE, closed LWPOLYLINE, POLYLINE+VERTEX,
+    CIRCLE tessellation; layer attribute column."""
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.registry import read
+
+    def pairs(*kv):
+        return "\n".join(str(x) for x in kv)
+
+    doc = pairs(
+        0, "SECTION", 2, "ENTITIES",
+        0, "POINT", 8, "sites", 10, 3.0, 20, 4.0,
+        0, "LINE", 8, "roads", 10, 0.0, 20, 0.0, 11, 5.0, 21, 5.0,
+        0, "LWPOLYLINE", 8, "parcels", 70, 1,
+        10, 0.0, 20, 0.0, 10, 4.0, 20, 0.0, 10, 4.0, 20, 3.0, 10, 0.0, 20, 3.0,
+        0, "POLYLINE", 8, "paths", 70, 0,
+        0, "VERTEX", 10, 0.0, 20, 0.0,
+        0, "VERTEX", 10, 1.0, 20, 2.0,
+        0, "VERTEX", 10, 2.0, 20, 0.0,
+        0, "SEQEND",
+        0, "CIRCLE", 8, "wells", 10, 10.0, 20, 10.0, 40, 2.0,
+        0, "ENDSEC",
+        0, "EOF",
+    ) + "\n"
+    p = tmp_path / "t.dxf"
+    p.write_text(doc)
+    t = read("dxf").load(p)
+    assert len(t) == 5
+    g = t.geometry
+    assert g.geometry_type(0) == GeometryType.POINT
+    assert g.geometry_type(1) == GeometryType.LINESTRING
+    assert g.geometry_type(2) == GeometryType.POLYGON
+    from mosaic_tpu import functions as F
+
+    assert abs(float(np.asarray(F.st_area(g.take([2])))[0]) - 12.0) < 1e-9
+    assert g.geometry_type(3) == GeometryType.LINESTRING
+    assert g.geom_xy(3).shape[0] == 3
+    assert g.geometry_type(4) == GeometryType.POLYGON
+    circ = float(np.asarray(F.st_area(g.take([4])))[0])
+    assert abs(circ - np.pi * 4.0) < 0.1  # 64-gon approximation
+    assert list(t.columns["layer"]) == [
+        "sites", "roads", "parcels", "paths", "wells"
+    ]
+
+
+def test_mif_skips_unsupported_objects_keeping_mid_alignment(tmp_path):
+    """TEXT/RECT objects become empty rows (OGR-skip analog) so the .mid
+    attribute rows stay aligned; a hole touching its shell still nests."""
+    from mosaic_tpu.readers.registry import read
+
+    mif = """VERSION 300
+COLUMNS 1
+  name Char(10)
+DATA
+POINT 1 2
+TEXT
+  "caption here"
+  0 0 5 1
+REGION 2
+  5
+0 0
+8 0
+8 8
+0 8
+0 0
+  4
+0 0
+3 1
+1 3
+0 0
+"""
+    mid = '"a"\n"skip"\n"holed"\n'
+    (tmp_path / "s.mif").write_text(mif)
+    (tmp_path / "s.mid").write_text(mid)
+    t = read("mif").load(tmp_path / "s.mif")
+    assert len(t) == 3
+    assert list(t.columns["name"]) == ["a", "skip", "holed"]
+    from mosaic_tpu import functions as F
+
+    # hole (area 4) shares vertex (0,0) with the shell — must still nest
+    area = float(np.asarray(F.st_area(t.geometry.take([2])))[0])
+    assert abs(area - (64.0 - 4.0)) < 1e-9
+
+
+def test_mif_dxf_through_open_any(tmp_path):
+    from mosaic_tpu.readers.vector import open_any
+
+    (tmp_path / "p.mif").write_text("VERSION 300\nCOLUMNS 0\nDATA\nPOINT 7 8\n")
+    assert len(open_any(tmp_path / "p.mif")) == 1
+    (tmp_path / "p.dxf").write_text(
+        "0\nSECTION\n2\nENTITIES\n0\nPOINT\n8\nL\n10\n1.0\n20\n2.0\n"
+        "0\nENDSEC\n0\nEOF\n"
+    )
+    assert len(open_any(tmp_path / "p.dxf")) == 1

@@ -120,8 +120,41 @@ def run_bench() -> bool:
     return ok
 
 
+def run_aux() -> None:
+    """After a good bench: capture the trace + stream artifacts on the
+    live chip (VERDICT r4 items 2 and 5). Each failure is just a trail
+    entry — a partial haul beats none."""
+    jobs = [
+        ("trace", [sys.executable, os.path.join(REPO, "tools", "trace_join.py"),
+                   "--out", os.path.join(REPO, "TRACE_r05.json")], 1200),
+        ("stream_devgen", [sys.executable,
+                           os.path.join(REPO, "tools", "stream_bench.py"),
+                           "--points", "100000000", "--device-gen",
+                           "--out", os.path.join(REPO, "STREAM_r05.json")], 1800),
+        ("stream_host", [sys.executable,
+                         os.path.join(REPO, "tools", "stream_bench.py"),
+                         "--points", "16000000",
+                         "--out", os.path.join(REPO, "STREAM_HOST_r05.json")],
+         1800),
+    ]
+    for name, cmd, tmo in jobs:
+        t0 = time.time()
+        try:
+            r = subprocess.run(
+                cmd, timeout=tmo, capture_output=True, text=True, cwd=REPO
+            )
+            tail = (r.stdout if r.returncode == 0 else r.stderr).strip()
+            log({"outcome": f"aux_{name}_rc{r.returncode}",
+                 "aux_s": round(time.time() - t0, 1),
+                 "tail": tail[-200:]})
+        except Exception as e:  # noqa: BLE001
+            log({"outcome": f"aux_{name}_fail:{e!r}"[:200],
+                 "aux_s": round(time.time() - t0, 1)})
+
+
 def main() -> None:
     last_bench = time.time() - REBENCH_S if _live_ok() else None
+    aux_done = os.path.exists(os.path.join(REPO, "TRACE_r05.json"))
     while True:
         rec = probe()
         rec["phase"] = "post-bench" if last_bench else "hunting"
@@ -131,6 +164,9 @@ def main() -> None:
         ):
             if run_bench():
                 last_bench = time.time()
+                if not aux_done:
+                    run_aux()
+                    aux_done = True
         # hunt aggressively until we have a number, then back off
         time.sleep(120.0 if last_bench else 30.0)
 
