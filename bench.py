@@ -341,6 +341,9 @@ def main():
         # rig ('axon') need not contain 'TPU', so the late-retry guard keys
         # on this instead of a substring match
         detail["platform"] = "tpu" if on_tpu else "cpu"
+        # MOSAIC_BENCH_FORCE_TPU_LANES exercises the TPU-only lanes on CPU
+        # (code-path testing; the numbers are meaningless there)
+        force_lanes = bool(os.environ.get("MOSAIC_BENCH_FORCE_TPU_LANES"))
         n_device = int(
             os.environ.get(
                 "MOSAIC_BENCH_POINTS", 4_000_000 if on_tpu else 1_000_000
@@ -402,8 +405,11 @@ def main():
             c = h3.point_to_cell(points_f64.astype(cell_dtype), RES)
             return c.astype(jnp.int64)
 
-        @functools.partial(jax.jit, static_argnames=("found_cap", "heavy_cap"))
-        def step(points_f64, chip_index, found_cap, heavy_cap):
+        @functools.partial(
+            jax.jit, static_argnames=("found_cap", "heavy_cap", "writeback")
+        )
+        def step(points_f64, chip_index, found_cap, heavy_cap,
+                 writeback="scatter"):
             cells = h3.point_to_cell(points_f64.astype(cell_dtype), RES)
             shifted = (points_f64 - chip_index.border.shift).astype(dtype)
             return pip_join_points(
@@ -412,6 +418,7 @@ def main():
                 chip_index,
                 heavy_cap=heavy_cap,
                 found_cap=found_cap,
+                writeback=writeback,
             )
 
         # full-bit XOR-shift fold: every result bit stays live (a masked
@@ -506,11 +513,11 @@ def main():
         rtt = min(rtts)
         detail["sync_rtt_s"] = round(rtt, 4)
 
-        def run_pass(sp, fc, hc):
+        def run_pass(sp, fc, hc, wb="scatter"):
             """Time one pass: dispatch every batch, force completion via
             the device fold of each output pulled as one chained scalar."""
             t0 = time.perf_counter()
-            outs = [step(sb, index, fc, hc) for sb in sp]
+            outs = [step(sb, index, fc, hc, writeback=wb) for sb in sp]
             tot = None
             for o in outs:
                 s = _fold(o)
@@ -545,6 +552,27 @@ def main():
         detail["passes_s"] = times
         dev_s = max(min(times) - rtt, 1e-9)
         dev_rate = n_device / dev_s
+        detail["writeback"] = {"scatter": round(dev_rate, 1)}
+
+        # TPU autotune: A/B the gather writeback (r3 traces put the final
+        # 4M scatter at ~30 ms) and headline the winner
+        if on_tpu or force_lanes:
+            try:
+                run_pass(staged_passes[0], fcap, hcap, wb="gather")  # compile
+                g_times = [
+                    round(run_pass(sp, fcap, hcap, wb="gather")[0], 4)
+                    for sp in staged_passes
+                ]
+                g_s = max(min(g_times) - rtt, 1e-9)
+                detail["writeback"]["gather"] = round(n_device / g_s, 1)
+                detail["writeback"]["gather_passes_s"] = g_times
+                if g_s < dev_s:
+                    dev_s, dev_rate = g_s, n_device / g_s
+                    detail["writeback"]["winner"] = "gather"
+                else:
+                    detail["writeback"]["winner"] = "scatter"
+            except Exception as e:
+                detail["writeback"]["gather_error"] = repr(e)[:200]
         # probe traffic: found points pay the tier-1 flat edge gather
         # (20 B/edge), heavy-cell points additionally the tier-2 row — the
         # HBM roofline of the join (misses stop at the 96 B hash bucket)
@@ -564,10 +592,6 @@ def main():
                 f"v5e HBM; heavy cells {hfrac:.1%} of {index.num_cells}"
             ),
         )
-
-        # MOSAIC_BENCH_FORCE_TPU_LANES exercises the TPU-only lanes on CPU
-        # (code-path testing; the numbers are meaningless there)
-        force_lanes = bool(os.environ.get("MOSAIC_BENCH_FORCE_TPU_LANES"))
 
         # Pallas zone-level kernel lane (the BASELINE.json north-star
         # kernel): brute-force PIP against every zone polygon, compiled
@@ -785,7 +809,7 @@ def main():
                         edge_eps2=jnp.asarray(eps2_val, dtype),
                     )
                     flagged = margins[..., 0] < km_val
-                    srcF, validF, overF = _compact(flagged, flag_cap)
+                    srcF, validF, overF, _ = _compact(flagged, flag_cap)
                     alt = h3.point_to_cell_alt(
                         points_f64[srcF].astype(cell_dtype), RES
                     ).astype(jnp.int64)

@@ -47,27 +47,30 @@ def read_dxf(path: str) -> VectorTable:
     in_poly = False
 
     def emit(kind: str, lay: str, d: dict[int, list[float]]):
+        # incomplete entities (missing paired codes) are skipped, not
+        # fatal — a truncated CAD export should not lose the whole file
         xs, ys = d.get(10, []), d.get(20, [])
-        if kind == "POINT" and xs:
+        if kind == "POINT" and xs and ys:
             b.add_geometry(
                 GeometryType.POINT, [[np.asarray([[xs[0], ys[0]]])]], 0
             )
             layers.append(lay)
-        elif kind == "LINE" and xs and d.get(11):
+        elif kind == "LINE" and xs and ys and d.get(11) and d.get(21):
             xy = np.asarray(
                 [[xs[0], ys[0]], [d[11][0], d[21][0]]]
             )
             b.add_geometry(GeometryType.LINESTRING, [[xy]], 0)
             layers.append(lay)
-        elif kind == "LWPOLYLINE" and len(xs) >= 2:
-            xy = np.stack([xs, ys], axis=-1)
+        elif kind == "LWPOLYLINE" and min(len(xs), len(ys)) >= 2:
+            k = min(len(xs), len(ys))
+            xy = np.stack([xs[:k], ys[:k]], axis=-1)
             closed = int(d.get(70, [0])[0]) & 1
-            if closed and len(xs) >= 3:
+            if closed and k >= 3:
                 b.add_geometry(GeometryType.POLYGON, [[xy]], 0)
             else:
                 b.add_geometry(GeometryType.LINESTRING, [[xy]], 0)
             layers.append(lay)
-        elif kind == "CIRCLE" and xs and d.get(40):
+        elif kind == "CIRCLE" and xs and ys and d.get(40):
             t = np.linspace(0.0, 2 * np.pi, 65)[:-1]
             xy = np.stack(
                 [xs[0] + d[40][0] * np.cos(t), ys[0] + d[40][0] * np.sin(t)],

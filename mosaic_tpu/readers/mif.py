@@ -34,28 +34,23 @@ def _emit_region(b: GeometryBuilder, rings: list[np.ndarray], srid: int):
 
 
 def _parse_mid(path: Path, names: list[str], types: list[str], delim: str):
+    import csv
+    import io
+
     cols: dict[str, list] = {n: [] for n in names}
     if not path.exists() or not names:
         return cols
-    for line in path.read_text(errors="replace").splitlines():
-        if not line.strip():
+    # stdlib csv handles quoted delimiters and MID's doubled-quote escape
+    # (the same pattern as readers/vector.py's csv_points)
+    text = path.read_text(errors="replace")
+    for vals in csv.reader(io.StringIO(text), delimiter=delim):
+        if not vals:
             continue
-        # quoted fields may contain the delimiter
-        vals, cur, q = [], "", False
-        for ch in line:
-            if ch == '"':
-                q = not q
-            elif ch == delim and not q:
-                vals.append(cur)
-                cur = ""
-            else:
-                cur += ch
-        vals.append(cur)
         # a short row (trailing empty field with no delimiter) must not
         # truncate the zip and silently drop whole columns
         vals += [""] * (len(names) - len(vals))
         for n, t, v in zip(names, types, vals):
-            v = v.strip().strip('"')
+            v = v.strip()
             if t in ("integer", "smallint"):
                 cols[n].append(int(v) if v else 0)
             elif t in ("float", "decimal"):

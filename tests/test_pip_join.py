@@ -89,3 +89,41 @@ def test_join_h3_nyc_box():
     # away from shared boundary everything must agree
     off_boundary = np.abs(pts[:, 0] - -73.96) > 1e-3
     np.testing.assert_array_equal(got[off_boundary], want[off_boundary])
+
+
+def test_writeback_variants_identical():
+    """The gather writeback is an autotuning knob: results must be
+    bitwise identical to the scatter path, bands included."""
+    import jax.numpy as jnp
+
+    from mosaic_tpu.core.index import H3
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.sql.join import build_chip_index, pip_join_points
+
+    col = wkt.from_wkt([
+        "POLYGON ((-74.02 40.70, -73.96 40.70, -73.96 40.76, "
+        "-74.02 40.76, -74.02 40.70))",
+        "POLYGON ((-73.96 40.70, -73.90 40.70, -73.90 40.76, "
+        "-73.96 40.76, -73.96 40.70))",
+    ])
+    idx = build_chip_index(tessellate(col, H3, 8, keep_core_geoms=False))
+    rng = np.random.default_rng(2)
+    pts = np.column_stack(
+        [rng.uniform(-74.05, -73.87, 5000), rng.uniform(40.68, 40.78, 5000)]
+    )
+    cells = H3.point_to_cell(jnp.asarray(pts), 8)
+    shifted = jnp.asarray(
+        pts - np.asarray(idx.border.shift, np.float64),
+        dtype=idx.border.verts.dtype,
+    )
+    eps2 = jnp.asarray(1e-10, idx.border.verts.dtype)
+    a, na = pip_join_points(shifted, cells, idx, edge_eps2=eps2)
+    g, ng = pip_join_points(
+        shifted, cells, idx, edge_eps2=eps2, writeback="gather"
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(g))
+    np.testing.assert_array_equal(np.asarray(na), np.asarray(ng))
+    # capped case: overflow marks must agree too
+    a2 = pip_join_points(shifted, cells, idx, found_cap=64)
+    g2 = pip_join_points(shifted, cells, idx, found_cap=64, writeback="gather")
+    np.testing.assert_array_equal(np.asarray(a2), np.asarray(g2))
