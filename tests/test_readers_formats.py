@@ -785,3 +785,97 @@ def test_mif_dxf_through_open_any(tmp_path):
         "0\nENDSEC\n0\nEOF\n"
     )
     assert len(open_any(tmp_path / "p.dxf")) == 1
+
+
+def _shp_record(recno: int, payload: bytes) -> bytes:
+    import struct
+
+    return struct.pack(">ii", recno, len(payload) // 2) + payload
+
+
+def test_shapefile_all_shape_types_and_dbf_typing(tmp_path):
+    """Hand-built .shp exercising NULL/POINT/MULTIPOINT/POLYLINE/POLYGON
+    records plus .dbf C/N/F/L typing and the .prj srid sniff."""
+    import struct
+
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.vector import read_shapefile
+
+    recs = []
+    # null shape
+    recs.append(_shp_record(1, struct.pack("<i", 0)))
+    # point
+    recs.append(_shp_record(2, struct.pack("<idd", 1, 3.0, 4.0)))
+    # multipoint: bbox + count + 2 points
+    mp = struct.pack("<i4di", 8, 0, 0, 2, 2, 2) + struct.pack(
+        "<4d", 0.0, 0.0, 2.0, 2.0
+    )
+    recs.append(_shp_record(3, mp))
+    # polyline, two parts
+    pl = (
+        struct.pack("<i4dii", 3, 0, 0, 5, 5, 2, 4)
+        + struct.pack("<2i", 0, 2)
+        + struct.pack("<8d", 0, 0, 1, 1, 2, 2, 3, 1)
+    )
+    recs.append(_shp_record(4, pl))
+    # polygon: CW shell + CCW hole (closed rings)
+    shell = [(0, 0), (0, 8), (8, 8), (8, 0), (0, 0)]  # CW (area<0 shoelace)
+    hole = [(2, 2), (4, 2), (4, 4), (2, 4), (2, 2)]  # CCW
+    pts = shell + hole
+    pg = (
+        struct.pack("<i4dii", 5, 0, 0, 8, 8, 2, len(pts))
+        + struct.pack("<2i", 0, len(shell))
+        + b"".join(struct.pack("<2d", x, y) for x, y in pts)
+    )
+    recs.append(_shp_record(5, pg))
+    body = b"".join(recs)
+    hdr = struct.pack(">i", 9994) + b"\0" * 20 + struct.pack(
+        ">i", (100 + len(body)) // 2
+    ) + struct.pack("<ii", 1000, 0) + struct.pack("<8d", 0, 0, 8, 8, 0, 0, 0, 0)
+    (tmp_path / "t.shp").write_bytes(hdr + body)
+
+    # dbf: name C(6), n N(6,0), f F(8,2), flag L(1)
+    def field(name, ftype, flen, fdec):
+        return name.ljust(11, "\0").encode() + ftype.encode() + b"\0" * 4 + bytes(
+            [flen, fdec]
+        ) + b"\0" * 14
+
+    fields = field("name", "C", 6, 0) + field("n", "N", 6, 0) + field(
+        "f", "F", 8, 2
+    ) + field("flag", "L", 1, 0)
+    rec_len = 1 + 6 + 6 + 8 + 1
+    rows = b""
+    for k in range(5):
+        rows += b" " + f"r{k}".ljust(6).encode() + str(k).rjust(6).encode() + (
+            f"{k + 0.5:8.2f}".encode()
+        ) + (b"T" if k % 2 else b"F")
+    hdr_len = 32 + 4 * 32 + 1
+    dbf = (
+        bytes([3, 126, 1, 1])
+        + struct.pack("<IHH", 5, hdr_len, rec_len)
+        + b"\0" * 20
+        + fields
+        + b"\x0d"
+        + rows
+    )
+    (tmp_path / "t.dbf").write_bytes(dbf)
+    (tmp_path / "t.prj").write_text('PROJCS["OSGB 1936 / British National Grid"]')
+
+    t = read_shapefile(str(tmp_path / "t.shp"))
+    g = t.geometry
+    assert len(t) == 5
+    assert g.geometry_type(1) == GeometryType.POINT
+    assert g.geometry_type(2) == GeometryType.MULTIPOINT
+    assert g.geometry_type(3) == GeometryType.MULTILINESTRING
+    assert g.geometry_type(4) == GeometryType.POLYGON
+    assert (np.asarray(g.srid) == 27700).all()  # .prj sniffed
+    from mosaic_tpu import functions as F
+
+    area = float(np.asarray(F.st_area(g.take([4])))[0])
+    assert abs(area - (64.0 - 4.0)) < 1e-9  # hole subtracted
+    assert t.columns["n"].dtype == np.int64 and t.columns["n"][3] == 3
+    assert t.columns["f"].dtype == np.float64 and t.columns["f"][2] == 2.5
+    assert t.columns["flag"].dtype == bool and list(t.columns["flag"][:2]) == [
+        False, True,
+    ]
+    assert t.columns["name"][0] == "r0"
