@@ -984,6 +984,107 @@ def omerc_inverse(p, en, xp=np):
     return xp.stack([lon, lat], axis=-1)
 
 
+# New Zealand Map Grid (EPSG method 9811, Reilly 1973): a 6th-order
+# complex-polynomial conformal projection. Published LINZ coefficients;
+# complex arithmetic is carried as explicit (re, im) pairs so the same
+# code jits on TPU (no complex dtype support there).
+_NZMG_A = (
+    0.6399175073, -0.1358797613, 0.063294409, -0.02526853, 0.0117879,
+    -0.0055161, 0.0026906, -0.001333, 0.00067, -0.00034,
+)
+_NZMG_B = (
+    (0.7557853228, 0.0),
+    (0.249204646, 0.003371507),
+    (-0.001541739, 0.041058560),
+    (-0.10162907, 0.01727609),
+    (-0.26623489, -0.36249218),
+    (-0.6870983, -1.1651967),
+)
+_NZMG_C = (
+    (1.3231270439, 0.0),
+    (-0.577245789, -0.007809598),
+    (0.508307513, -0.112208952),
+    (-0.15094762, 0.18200602),
+    (1.01418179, 1.64497696),
+    (1.9660549, 2.5127645),
+)
+_NZMG_D = (
+    1.5627014243, 0.5185406398, -0.03333098, -0.1052906, -0.0368594,
+    0.007317, 0.01220, 0.00394, -0.0013,
+)
+
+
+def _cpoly(coeffs, zr, zi, xp):
+    """Horner evaluation of sum_k c_k z^k (k >= 1) with (re, im) pairs."""
+    hr = xp.zeros_like(zr)
+    hi = xp.zeros_like(zi)
+    for cr, ci in reversed(coeffs):
+        hr, hi = hr + cr, hi + ci
+        hr, hi = hr * zr - hi * zi, hr * zi + hi * zr
+    return hr, hi
+
+
+def _cpoly_deriv(coeffs, zr, zi, xp):
+    """d/dz of the same polynomial: sum_k k c_k z^(k-1)."""
+    hr = xp.zeros_like(zr)
+    hi = xp.zeros_like(zi)
+    for k in range(len(coeffs), 0, -1):
+        cr, ci = coeffs[k - 1]
+        hr, hi = hr + k * cr, hi + k * ci
+        if k > 1:
+            hr, hi = hr * zr - hi * zi, hr * zi + hi * zr
+    return hr, hi
+
+
+def nzmg_forward(p, lonlat, xp=np):
+    """New Zealand Map Grid (Reilly 1973; EPSG 9811, code 27200)."""
+    a, lat0, lon0, fe, fn = p
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    # delta-phi in units of 1e-5 arcseconds, per the LINZ formulation
+    dphi = (lat - lat0) * (180.0 * 3600.0 / math.pi) * 1e-5
+    psi = xp.zeros_like(dphi)
+    for A in reversed(_NZMG_A):
+        psi = (psi + A) * dphi
+    zr, zi = psi, lon - lon0
+    hr, hi = _cpoly(_NZMG_B, zr, zi, xp)
+    return xp.stack([fe + a * hi, fn + a * hr], axis=-1)
+
+
+def nzmg_inverse(p, en, xp=np, iters: int = 4):
+    a, lat0, lon0, fe, fn = p
+    zi_t = (en[..., 0] - fe) / a  # Im(zeta)
+    zr_t = (en[..., 1] - fn) / a  # Re(zeta)
+    # initial guess from the published inverse series, then Newton on the
+    # forward polynomial (fixed count: jit-safe; converges in 2-3 rounds)
+    zr, zi = _cpoly(_NZMG_C, zr_t, zi_t, xp)
+    for _ in range(iters):
+        fr, fi = _cpoly(_NZMG_B, zr, zi, xp)
+        dr, di = _cpoly_deriv(_NZMG_B, zr, zi, xp)
+        rr, ri = fr - zr_t, fi - zi_t
+        den = dr * dr + di * di
+        den = xp.where(den == 0, 1e-30, den)
+        zr = zr - (rr * dr + ri * di) / den
+        zi = zi - (ri * dr - rr * di) / den
+    psi, dlam = zr, zi
+    # D-series is the published INITIAL GUESS only; Newton on the A-series
+    # (per the LINZ algorithm) takes phi to full precision
+    dphi = xp.zeros_like(psi)
+    for D in reversed(_NZMG_D):
+        dphi = (dphi + D) * psi
+    for _ in range(2):
+        f = xp.zeros_like(dphi)
+        for A in reversed(_NZMG_A):
+            f = (f + A) * dphi
+        fp = xp.zeros_like(dphi)
+        for k in range(len(_NZMG_A), 0, -1):
+            fp = fp + k * _NZMG_A[k - 1]
+            if k > 1:
+                fp = fp * dphi
+        dphi = dphi - (f - psi) / fp
+    lat = lat0 + dphi * 1e5 / (180.0 * 3600.0 / math.pi)
+    return xp.stack([lon0 + dlam, lat], axis=-1)
+
+
 def tm_south_forward(p: TMParams, lonlat, xp=np):
     """Transverse Mercator South Orientated (EPSG method 9808, the South
     African Lo grids): westing/southing — the TM axes negated."""
@@ -1298,6 +1399,7 @@ _FAMILY_FNS = {
     "eqdc": (eqdc_forward, eqdc_inverse),
     "omerc": (omerc_forward, omerc_inverse),
     "tm_south": (tm_south_forward, tm_south_inverse),
+    "nzmg": (nzmg_forward, nzmg_inverse),
 }
 
 

@@ -37,6 +37,8 @@ from .crs import (
     eqdc_inverse,
     laea_forward,
     laea_inverse,
+    nzmg_forward,
+    nzmg_inverse,
     omerc_forward,
     omerc_inverse,
     tm_south_forward,
@@ -104,7 +106,7 @@ UNITS: dict[str, float] = {
 _SUPPORTED_PROJ = (
     "utm, tmerc (incl. +axis=wsu south-orientated), merc, lcc, aea, eqdc, "
     "laea, stere (polar), sterea, somerc, omerc (Hotine A/B), krovak, "
-    "cass, poly, longlat/latlong"
+    "cass, poly, nzmg, longlat/latlong"
 )
 
 
@@ -290,6 +292,23 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
     if proj == "cass":
         p = (a, e, lat0, lon0, fe, fn)
         return ProjCRS("cass", p, a, e2, shift, to_meter, area)
+    if proj == "nzmg":
+        # fixed published definition; parameters default to NZMG's own —
+        # including the International 1924 ellipsoid the Reilly
+        # polynomial was fitted for (a bare +proj=nzmg must not pick up
+        # the global WGS84 default: ~4e-5 relative scale error)
+        if not any(k in kv for k in ("a", "b", "rf", "ellps", "datum")):
+            a, rf = ELLIPSOIDS["intl"]
+            f_ = 1.0 / rf
+            e2 = f_ * (2 - f_)
+        p = (
+            a,
+            lat0 if _f(kv, "lat_0") is not None else _R(-41.0),
+            lon0 if _f(kv, "lon_0") is not None else _R(173.0),
+            fe if _f(kv, "x_0") is not None else 2510000.0,
+            fn if _f(kv, "y_0") is not None else 6023150.0,
+        )
+        return ProjCRS("nzmg", p, a, e2, shift, to_meter, area)
     if proj == "omerc":
         lonc = _R(_f(kv, "lonc", math.degrees(lon0)))
         alpha = _f(kv, "alpha")
@@ -352,6 +371,7 @@ parse_proj.__doc__ = parse_proj.__doc__.format(supported=_SUPPORTED_PROJ)
 
 
 _FWD = {
+    "nzmg": nzmg_forward,
     "cass": cass_forward,
     "eqdc": eqdc_forward,
     "omerc": omerc_forward,
@@ -368,6 +388,7 @@ _FWD = {
     "merc": merc_forward,
 }
 _INV = {
+    "nzmg": nzmg_inverse,
     "cass": cass_inverse,
     "eqdc": eqdc_inverse,
     "omerc": omerc_inverse,
@@ -438,6 +459,8 @@ def default_area(crs: ProjCRS) -> tuple[float, float, float, float]:
     if crs.kind in ("tm", "tm_south"):
         lon0 = math.degrees(crs.params.lon0)
         return (lon0 - 3.5, -80.0, lon0 + 3.5, 84.0)
+    if crs.kind == "nzmg":
+        return (166.37, -47.33, 178.63, -34.1)
     if crs.kind == "cass":
         _, _, lat0, lon0, _, _ = crs.params
         lat0, lon0 = math.degrees(lat0), math.degrees(lon0)
@@ -653,6 +676,12 @@ _EPSG: dict[int, tuple[str, tuple[float, float, float, float]]] = {
         "+gamma=53.13010236111111 +k=0.99984 +x_0=590476.87 "
         "+y_0=442857.65 +a=6377298.556 +rf=300.8017 +towgs84=-679,669,-48",
         (109.55, 0.85, 115.86, 7.35),
+    ),
+    # NZGD49 / New Zealand Map Grid (EPSG 9811, complex polynomial)
+    27200: (
+        "+proj=nzmg +lat_0=-41 +lon_0=173 +x_0=2510000 +y_0=6023150 "
+        "+datum=nzgd49",
+        (166.37, -47.33, 178.63, -34.1),
     ),
     # ---- Cassini-Soldner (EPSG 9806)
     # Palestine 1923 / Palestine Grid (Clarke 1880 Benoit)

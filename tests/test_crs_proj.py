@@ -451,6 +451,72 @@ def test_eqdc_distance_property():
     assert abs(seg - arc) / arc < 1e-6
 
 
+def test_nzmg_roundtrip_and_conformality():
+    """NZMG (EPSG 27200, complex polynomial): the projection origin maps
+    to (FE, FN) exactly; the grid round-trips to fp precision; and the
+    map is CONFORMAL — equal-length isometric steps project to equal-
+    length orthogonal steps, an independent check of the published
+    Reilly coefficients."""
+    import math
+
+    from mosaic_tpu.core.crs import nzmg_forward
+
+    d = math.radians
+    p = (6378388.0, d(-41.0), d(173.0), 2510000.0, 6023150.0)
+    np.testing.assert_allclose(
+        nzmg_forward(p, np.array([[d(173.0), d(-41.0)]]))[0],
+        [2510000.0, 6023150.0],
+        atol=1e-6,
+    )
+    # intrinsic series check: the published inverse series must compose
+    # with the forward series to identity (catches any transcription
+    # error in either tail — a 10x slip in A5 moves this by ~1e-4)
+    from mosaic_tpu.core.crs import _NZMG_A, _NZMG_D
+
+    x = np.array([0.236, -0.2, 0.1, 0.3])
+    psi = np.zeros_like(x)
+    for A in reversed(_NZMG_A):
+        psi = (psi + A) * x
+    back = np.zeros_like(psi)
+    for D in reversed(_NZMG_D):
+        back = (back + D) * psi
+    assert np.abs(back - x).max() < 1e-9
+    # LINZ worked example (NZGD49 lat/lon -> NZMG): 5 m tolerance covers
+    # the quoted-precision uncertainty while catching coefficient errors
+    # (which show up as hundreds of metres)
+    lat = -d(34 + 26 / 60 + 38.727 / 3600)
+    lon = d(172 + 44 / 60 + 21.099 / 3600)
+    en = nzmg_forward(p, np.array([[lon, lat]]))[0]
+    np.testing.assert_allclose(en, [2487100.638, 6751049.719], atol=5.0)
+    # public-API roundtrip (incl. the NZGD49 Helmert)
+    ll = _interior_grid(27200)
+    rt = crs.to_wgs84(crs.from_wgs84(ll, 27200), 27200)
+    assert np.abs(rt - ll).max() < 5e-7
+    # conformality: tight near the origin; NZMG is a FITTED nearly-
+    # conformal map, so the deviation legitimately grows to ~1e-3 at the
+    # national edges (that bound is part of the projection's definition)
+    f = 1 / 297.0
+    e2 = 2 * f - f * f
+    for (phi_d, lam_d, tol) in [(-41.5, 172.0, 1e-6), (-44.5, 169.0, 2e-3)]:
+        phi0, lam0 = d(phi_d), d(lam_d)
+        s, c = math.sin(phi0), math.cos(phi0)
+        dq_dphi = (1 - e2) / ((1 - e2 * s * s) * c)
+        dl = 1e-6
+        base = nzmg_forward(p, np.array([[lam0, phi0]]))[0]
+        dN = (
+            nzmg_forward(p, np.array([[lam0, phi0 + dl / dq_dphi]]))[0] - base
+        )
+        dE = nzmg_forward(p, np.array([[lam0 + dl, phi0]]))[0] - base
+        ratio = np.hypot(*dN) / np.hypot(*dE)
+        ang = (
+            math.degrees(
+                math.atan2(dN[1], dN[0]) - math.atan2(dE[1], dE[0])
+            ) % 360.0
+        )
+        assert abs(ratio - 1.0) < tol, (phi_d, lam_d, ratio)
+        assert abs(ang - 90.0) < 1e-3
+
+
 def test_datum_shift_geographic_crs():
     # 4277 (OSGB36 geographic): shifting Greenwich to WGS84 moves it ~100 m
     ll_osgb = np.array([[0.0, 51.4778]])
