@@ -212,3 +212,27 @@ def test_recheck_requires_host_companion():
     )
     with pytest.raises(ValueError, match="host companion"):
         pip_join(pts, None, H3, 8, chip_index=stripped, recheck=True)
+
+
+def test_pip_join_recheck_bng_no_alt_fallback():
+    """BNG has margins but no alternate-rounding: the whole flagged band
+    escalates to the host oracle — still exactly equal to f64."""
+    from mosaic_tpu.core.tessellate import tessellate
+
+    col = wkt.from_wkt([
+        "POLYGON ((400000 200000, 440000 200000, 440000 240000, "
+        "400000 240000, 400000 200000))",
+        "POLYGON ((440000 200000, 480000 200000, 480000 240000, "
+        "440000 240000, 440000 200000))",
+    ])
+    idx = build_chip_index(tessellate(col, BNG, 3, keep_core_geoms=False))
+    rng = np.random.default_rng(4)
+    pts = np.column_stack(
+        [rng.uniform(395000, 485000, 20000), rng.uniform(195000, 245000, 20000)]
+    )
+    got = pip_join(
+        pts, None, BNG, 3, chip_index=idx,
+        recheck=True, cell_dtype=jnp.float32,
+    )
+    truth = host_join(pts, idx.host, BNG, 3)
+    np.testing.assert_array_equal(got, truth)
