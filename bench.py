@@ -573,6 +573,24 @@ def main():
                     detail["writeback"]["winner"] = "scatter"
             except Exception as e:
                 detail["writeback"]["gather_error"] = repr(e)[:200]
+            # third variant: no tier-1 compaction at all (every point
+            # gathers its own edge row; wins when prefix+scatter+
+            # writeback cost more than the wasted miss gathers). Own try:
+            # a direct failure must not lose the scatter/gather verdict.
+            try:
+                run_pass(staged_passes[0], fcap, hcap, wb="direct")
+                d_times = [
+                    round(run_pass(sp, fcap, hcap, wb="direct")[0], 4)
+                    for sp in staged_passes
+                ]
+                d_s = max(min(d_times) - rtt, 1e-9)
+                detail["writeback"]["direct"] = round(n_device / d_s, 1)
+                detail["writeback"]["direct_passes_s"] = d_times
+                if d_s < dev_s:
+                    dev_s, dev_rate = d_s, n_device / d_s
+                    detail["writeback"]["winner"] = "direct"
+            except Exception as e:
+                detail["writeback"]["direct_error"] = repr(e)[:200]
         # probe traffic: found points pay the tier-1 flat edge gather
         # (20 B/edge), heavy-cell points additionally the tier-2 row — the
         # HBM roofline of the join (misses stop at the 96 B hash bucket)

@@ -702,6 +702,33 @@ def _compact(flag: jax.Array, cap: int):
     return src, valid, flag & (pos >= cap), pos
 
 
+def _heavy_tier(px, py, hs, index, heavy_cap, k2_default, out_len, eps2):
+    """Tier 2, shared by every probe plumbing mode: compact the rows whose
+    cell is heavy, probe the wide rows, scatter back to ``out_len``.
+
+    Returns (best2 (out_len,), over2 (out_len,) overflow mask,
+    near2 (out_len,) | None when ``eps2`` is None)."""
+    K2 = int(heavy_cap) if heavy_cap else k2_default
+    K2 = max(8, min(K2, k2_default))
+    src2, valid2, over2, _ = _compact(hs >= 0, K2)
+    h2 = jnp.maximum(hs[src2], 0)
+    r2 = _ray_parity(
+        px[src2], py[src2], index.heavy_edges[h2], index.heavy_ebits[h2],
+        eps2=eps2,
+    )
+    par2, near2 = r2 if eps2 is not None else (r2, None)
+    best2k = jnp.where(
+        valid2, _slot_best(par2, index.heavy_slot_geom[h2]), _SENTINEL
+    )
+    best2 = jnp.full(out_len, _SENTINEL, dtype=jnp.int32).at[src2].min(best2k)
+    near_sc = (
+        jnp.zeros(out_len, bool).at[src2].max(near2 & valid2)
+        if eps2 is not None
+        else None
+    )
+    return best2, over2, near_sc
+
+
 def pip_join_points(
     points: jax.Array,
     pcells: jax.Array,
@@ -732,17 +759,53 @@ def pip_join_points(
     sqrt(edge_eps2) of any probed chip edge — the set whose f32 parity may
     disagree with f64 (`pip_join` rechecks them on the host oracle).
 
-    ``writeback`` picks how compacted results return to the full point
-    axis: ``"scatter"`` (sorted scatter-min) or ``"gather"`` (each point
-    gathers its own compacted slot via the prefix). Identical results —
-    a TPU autotuning knob (r3 traces: the 4M scatter costs ~30 ms; the
-    bench measures both and reports the winner).
+    ``writeback`` picks the probe plumbing — identical results, a TPU
+    autotuning knob the bench measures and picks the winner of:
+    ``"scatter"`` compacts found points then returns results via sorted
+    scatter-min; ``"gather"`` compacts but inverts by per-point gather of
+    the prefix slot; ``"direct"`` skips tier-1 compaction entirely —
+    every point gathers its own 512 B edge row (wasted gathers on misses,
+    but no prefix scan, no point permutation and no writeback, which cost
+    ~65 ms combined at 4M on v5e while the full row-gather runs ~30 ms;
+    ``found_cap`` is ignored and tier-1 overflow is impossible).
     """
-    if writeback not in ("scatter", "gather"):
-        raise ValueError(f"writeback must be scatter|gather, got {writeback!r}")
+    if writeback not in ("scatter", "gather", "direct"):
+        raise ValueError(
+            f"writeback must be scatter|gather|direct, got {writeback!r}"
+        )
     N = points.shape[0]
     u = _probe_slot(pcells, index)
     found = u >= 0
+    banded_d = edge_eps2 is not None
+    H = int(index.heavy_edges.shape[0])
+
+    if writeback == "direct":
+        us = jnp.maximum(u, 0)
+        r1 = _ray_parity(
+            points[:, 0], points[:, 1],
+            index.cell_edges[us], index.cell_ebits[us],
+            eps2=edge_eps2,
+        )
+        parity, near1 = r1 if banded_d else (r1, None)
+        best = _slot_best(
+            parity, index.cell_slot_geom[us], index.cell_slot_core[us]
+        )
+        best = jnp.where(found, best, _SENTINEL)
+        if H:
+            hs = jnp.where(found, index.cell_heavy[us], -1)
+            best2, over2, near_sc = _heavy_tier(
+                points[:, 0], points[:, 1], hs, index, heavy_cap, N, N,
+                edge_eps2,
+            )
+            best = jnp.minimum(best, best2)
+            best = jnp.where(over2, _OVF_MARK, best)
+            if banded_d:
+                near1 = near1 | near_sc
+        out = jnp.where(best == _SENTINEL, -1, best).astype(jnp.int32)
+        out = jnp.where(best == _OVF_MARK, OVERFLOW, out)
+        if banded_d:
+            return out, near1 & found
+        return out
 
     K1 = int(found_cap) if found_cap else N
     K1 = max(8, min(K1, N))
@@ -761,33 +824,18 @@ def pip_join_points(
     )
     best1 = jnp.where(valid1, best1, _SENTINEL)
 
-    H = int(index.heavy_edges.shape[0])
     if H:
         # tier 2: compact again to the points whose cell is heavy
-        K2 = int(heavy_cap) if heavy_cap else K1
-        K2 = min(K2, K1)
         hs = jnp.where(valid1, index.cell_heavy[us], -1)
-        src2, valid2, over2, _ = _compact(hs >= 0, K2)
-        h2 = jnp.maximum(hs[src2], 0)
-        r2 = _ray_parity(
-            px[src2], py[src2], index.heavy_edges[h2], index.heavy_ebits[h2],
-            eps2=edge_eps2,
-        )
-        par2, near2 = r2 if banded else (r2, None)
-        best2k = jnp.where(
-            valid2, _slot_best(par2, index.heavy_slot_geom[h2]), _SENTINEL
-        )
-        best2 = (
-            jnp.full(K1, _SENTINEL, dtype=jnp.int32).at[src2].min(best2k)
+        best2, over2, near_sc = _heavy_tier(
+            px, py, hs, index, heavy_cap, K1, K1, edge_eps2
         )
         best1 = jnp.minimum(best1, best2)
         # an overflowed tier-2 point has an unknown answer even if tier 1
         # hit: mark it (marker < SENTINEL so the scatter-min keeps it)
         best1 = jnp.where(over2, _OVF_MARK, best1)
         if banded:
-            near1 = near1 | (
-                jnp.zeros(K1, bool).at[src2].max(near2 & valid2)
-            )
+            near1 = near1 | near_sc
 
     # return compacted results to the full point axis
     if writeback == "gather":
@@ -866,6 +914,7 @@ def pip_join(
     batch_size: int | None = None,
     recheck: bool | None = None,
     cell_dtype=None,
+    writeback: str = "scatter",
 ) -> np.ndarray:
     """Managed join (reference: `PointInPolygonJoin.join` auto-indexes both
     sides, `sql/join/PointInPolygonJoin.scala:86-97`).
@@ -892,6 +941,10 @@ def pip_join(
     ``cell_dtype`` forces the dtype cells are computed in (default: the
     input device array's dtype — f32 on TPU) — lets CPU/x64 tests
     reproduce TPU f32 behavior exactly.
+
+    ``writeback`` selects the probe plumbing (``scatter``/``gather``/
+    ``direct`` — see :func:`pip_join_points`); results are identical,
+    the bench autotunes the winner per workload.
     """
     resolution = index_system.resolution_arg(resolution)
     if chip_index is None:
@@ -930,19 +983,38 @@ def pip_join(
             cells = _assign_cells(index_system, resolution, dev, "cells")
             margins = None
         # exact cap sizing from two scalars (pow2-bucketed to bound the
-        # number of distinct compiled programs) — overflow impossible
-        nf, nh = (int(v) for v in np.asarray(_JIT_COUNTS(cells, chip_index)))
-        fcap = min(_next_pow2(nf + 1), chunk.shape[0])
-        hcap = (
-            min(_next_pow2(nh + 1), fcap)
-            if chip_index.num_heavy_cells
-            else None
-        )
+        # number of distinct compiled programs) — overflow impossible.
+        # Direct mode has no tier-1 compaction: found_cap is unused, so
+        # None keeps the jit static key stable across batches (and with
+        # no heavy cells the count sync is skipped entirely).
+        if writeback == "direct":
+            fcap = None
+            hcap = (
+                min(
+                    _next_pow2(
+                        int(np.asarray(_JIT_COUNTS(cells, chip_index))[1]) + 1
+                    ),
+                    chunk.shape[0],
+                )
+                if chip_index.num_heavy_cells
+                else None
+            )
+        else:
+            nf, nh = (
+                int(v) for v in np.asarray(_JIT_COUNTS(cells, chip_index))
+            )
+            fcap = min(_next_pow2(nf + 1), chunk.shape[0])
+            hcap = (
+                min(_next_pow2(nh + 1), fcap)
+                if chip_index.num_heavy_cells
+                else None
+            )
         shifted = jnp.asarray(chunk - shift, dtype=dtype)
         if not recheck:
             return np.asarray(
                 _JIT_JOIN(
-                    shifted, cells, chip_index, heavy_cap=hcap, found_cap=fcap
+                    shifted, cells, chip_index,
+                    heavy_cap=hcap, found_cap=fcap, writeback=writeback,
                 )
             )
 
@@ -955,6 +1027,7 @@ def pip_join(
         out_dev, near = _JIT_JOIN(
             shifted, cells, chip_index,
             heavy_cap=hcap, found_cap=fcap, edge_eps2=eps2,
+            writeback=writeback,
         )
         out = np.array(out_dev)  # writable host copies
         host_mask = np.array(near)  # PIP-boundary band -> host

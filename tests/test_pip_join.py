@@ -118,12 +118,18 @@ def test_writeback_variants_identical():
     )
     eps2 = jnp.asarray(1e-10, idx.border.verts.dtype)
     a, na = pip_join_points(shifted, cells, idx, edge_eps2=eps2)
-    g, ng = pip_join_points(
-        shifted, cells, idx, edge_eps2=eps2, writeback="gather"
-    )
-    np.testing.assert_array_equal(np.asarray(a), np.asarray(g))
-    np.testing.assert_array_equal(np.asarray(na), np.asarray(ng))
-    # capped case: overflow marks must agree too
+    for wb in ("gather", "direct"):
+        g, ng = pip_join_points(
+            shifted, cells, idx, edge_eps2=eps2, writeback=wb
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(g), wb)
+        np.testing.assert_array_equal(np.asarray(na), np.asarray(ng), wb)
+    # capped case: overflow marks must agree too (direct has no tier-1
+    # cap, so it is exact wherever the capped runs did not overflow)
     a2 = pip_join_points(shifted, cells, idx, found_cap=64)
     g2 = pip_join_points(shifted, cells, idx, found_cap=64, writeback="gather")
     np.testing.assert_array_equal(np.asarray(a2), np.asarray(g2))
+    d2 = np.asarray(pip_join_points(shifted, cells, idx, writeback="direct"))
+    a2 = np.asarray(a2)
+    ok = a2 != -2
+    np.testing.assert_array_equal(a2[ok], d2[ok])
