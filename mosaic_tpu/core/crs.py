@@ -260,8 +260,10 @@ def _phi_from_ts(ts, e, xp, iters: int = 10):
 
 
 def _q_fn(phi, e, xp):
-    """Authalic q (Snyder 3-12)."""
+    """Authalic q (Snyder 3-12); sphere limit q = 2 sin(phi) as e -> 0."""
     s = xp.sin(phi)
+    if e < 1e-12:  # sphere (e.g. EPSG 2163's authalic sphere)
+        return 2.0 * s
     return (1 - e * e) * (
         s / (1 - e * e * s * s) - (1 / (2 * e)) * xp.log((1 - e * s) / (1 + e * s))
     )
@@ -269,6 +271,8 @@ def _q_fn(phi, e, xp):
 
 def _phi_from_q(q, e, xp, iters: int = 8):
     phi = xp.arcsin(xp.clip(q / 2, -1.0, 1.0))
+    if e < 1e-12:  # sphere: the arcsin IS the inverse
+        return phi
     for _ in range(iters):
         s = xp.sin(phi)
         c = xp.cos(phi)

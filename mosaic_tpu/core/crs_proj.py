@@ -677,6 +677,11 @@ _EPSG: dict[int, tuple[str, tuple[float, float, float, float]]] = {
         "+y_0=442857.65 +a=6377298.556 +rf=300.8017 +towgs84=-679,669,-48",
         (109.55, 0.85, 115.86, 7.35),
     ),
+    # US National Atlas Equal Area (authalic sphere LAEA)
+    2163: (
+        "+proj=laea +lat_0=45 +lon_0=-100 +x_0=0 +y_0=0 +a=6370997 +b=6370997",
+        (-130.0, 23.0, -65.0, 50.0),
+    ),
     # NZGD49 / New Zealand Map Grid (EPSG 9811, complex polynomial)
     27200: (
         "+proj=nzmg +lat_0=-41 +lon_0=173 +x_0=2510000 +y_0=6023150 "
@@ -711,6 +716,16 @@ _EPSG: dict[int, tuple[str, tuple[float, float, float, float]]] = {
         (25.0, 10.0, 180.0, 84.0),
     ),
 }
+
+# POSGAR 2007 / Argentina fajas 1..7 (EPSG 5343..5349, faja z = 5342+z):
+# Gauss-Krueger with lon_0 = -72 + 3(z-1), x_0 = z*1e6 + 500000, y_0 = 0,
+# lat_0 = -90 (note the SOUTH-POLE origin: northings count from the pole)
+for _z in range(1, 8):
+    _EPSG[5342 + _z] = (
+        f"+proj=tmerc +lat_0=-90 +lon_0={-72 + 3 * (_z - 1)} +k=1 "
+        f"+x_0={_z}500000 +y_0=0 " + _GRS,
+        (-73.6 + 3 * (_z - 1), -55.1, -70.5 + 3 * (_z - 1), -21.7),
+    )
 
 # Hartebeesthoek94 / Lo15..Lo33 (EPSG 2046..2055): south-orientated TM
 # (EPSG method 9808) — westing/southing axes via +axis=wsu

@@ -41,6 +41,8 @@ _ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [
     28355, 31983, 7855, 31970, 3395, 3435, 21781, 5514, 5880,
     # round-5 families: omerc A/B, cass, eqdc, south-orientated tmerc
     26931, 3375, 3376, 29873, 28191, 24500, 102031, 102026, 2048, 2053,
+    # round-5 additions: NZMG, sphere-LAEA, POSGAR south-pole-origin GK
+    27200, 2163, 5343, 5345, 5349,
 ]
 
 
