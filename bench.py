@@ -62,6 +62,34 @@ RES = 9
 NYC_FIXTURE = "/root/reference/src/test/resources/NYC_Taxi_Zones.geojson"
 _I32_MAX = np.iinfo(np.int32).max
 
+_T0 = time.perf_counter()
+
+
+_PARTIAL_PATH = os.environ.get("MOSAIC_BENCH_PARTIAL")
+
+
+def _prog(msg: str) -> None:
+    """Stderr progress mark (stdout carries only the JSON line). The
+    tunnel makes some compiles minutes-long; without these marks a slow
+    lane is indistinguishable from a hang.
+
+    When MOSAIC_BENCH_PARTIAL names a file, the current ``detail`` dict is
+    also checkpointed there at every mark — the tunnel can die mid-bench
+    (observed 2026-07-31: alive at 01:01, hung at 01:33), and a partial
+    artifact with the main-lane number beats losing the whole run."""
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+    detail = getattr(_prog, "detail", None)
+    if _PARTIAL_PATH and detail is not None:
+        try:
+            tmp = _PARTIAL_PATH + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"stage": msg, "detail": detail}, f,
+                          indent=1, default=str)
+            os.replace(tmp, _PARTIAL_PATH)
+        except Exception:  # noqa: BLE001 — best-effort: a salvage helper
+            pass           # must never be what kills the bench
+
 
 def _np_parity(px, py, e, bits):
     # single source of truth for the host parity lives in the library
@@ -322,8 +350,10 @@ def main():
         )
     }
     t_start = time.perf_counter()
+    _prog.detail = detail  # type: ignore[attr-defined] — partial checkpoints
     try:
         platform = _probe_platform(detail)
+        _prog(f"platform verdict: {platform}")
         if platform == "cpu":
             import jax
 
@@ -336,6 +366,7 @@ def main():
         from mosaic_tpu.sql.join import pip_join_points
 
         detail["device"] = str(jax.devices()[0])
+        _prog(f"device: {detail['device']}")
         on_tpu = jax.devices()[0].platform not in ("cpu",)
         # the measured platform, recorded explicitly: device strings on this
         # rig ('axon') need not contain 'TPU', so the late-retry guard keys
@@ -370,6 +401,7 @@ def main():
         index, cache_hit, tess_only_s = _load_or_build_index(
             zones, zones_src, h3
         )
+        _prog(f"index ready (cache_hit={cache_hit})")
         # on a hit this is npz-load time, NOT tessellation speed — the
         # flag keeps cross-round comparisons honest
         tess_s = time.perf_counter() - t0
@@ -394,6 +426,7 @@ def main():
         # one contiguous host pool sliced into n_passes DISTINCT point
         # sets — identical (fn, input) re-execution is untrustworthy on
         # this rig (results can come back cached)
+        _prog("generating host point pool")
         all_pts = random_points(n_passes * n_device, bbox=bbox, seed=11)
         shift = np.asarray(index.border.shift, dtype=np.float64)
         dtype = index.border.verts.dtype
@@ -464,6 +497,7 @@ def main():
         # warm up compile on one batch; on compile failure halve the batch
         # and retry so the bench always records a real number
         attempts = []
+        _prog(f"compiling main step (batch={batch})")
         while True:
             try:
                 first = jnp.asarray(all_pts[:batch])
@@ -480,6 +514,7 @@ def main():
                 hcap = min(hcap, fcap) if hcap else hcap
         if attempts:
             detail["compile_attempts"] = attempts
+        _prog(f"main step compiled in {detail.get('compile_s')}s")
         detail["batch"] = batch
         detail["caps"] = [fcap, hcap]
 
@@ -494,10 +529,12 @@ def main():
                 sb.block_until_ready()
             return sp
 
+        _prog("staging passes to device")
         staged_passes = [
             stage(all_pts[p * n_device : (p + 1) * n_device])
             for p in range(n_passes)
         ]
+        _prog("staging done")
 
         # fixed sync round-trip: min of three scalar pulls of values that
         # are already computed — subtracted from every timed pass
@@ -542,6 +579,7 @@ def main():
                     outs0 = outs
             return times, outs0, n_match, n_over
 
+        _prog("measuring scatter writeback")
         times, outs0, n_match, n_over = measure(fcap, hcap)
         if n_over:  # compaction cap overflow: redo at doubled caps
             fcap = min(fcap * 2, batch)
@@ -553,11 +591,13 @@ def main():
         dev_s = max(min(times) - rtt, 1e-9)
         dev_rate = n_device / dev_s
         detail["writeback"] = {"scatter": round(dev_rate, 1)}
+        detail["main_points_per_sec"] = round(dev_rate, 1)
 
         # TPU autotune: A/B the gather writeback (r3 traces put the final
         # 4M scatter at ~30 ms) and headline the winner
         if on_tpu or force_lanes:
             try:
+                _prog("gather writeback lane")
                 run_pass(staged_passes[0], fcap, hcap, wb="gather")  # compile
                 g_times = [
                     round(run_pass(sp, fcap, hcap, wb="gather")[0], 4)
@@ -578,6 +618,7 @@ def main():
             # writeback cost more than the wasted miss gathers). Own try:
             # a direct failure must not lose the scatter/gather verdict.
             try:
+                _prog("direct writeback lane")
                 run_pass(staged_passes[0], fcap, hcap, wb="direct")
                 d_times = [
                     round(run_pass(sp, fcap, hcap, wb="direct")[0], 4)
@@ -591,6 +632,7 @@ def main():
                     detail["writeback"]["winner"] = "direct"
             except Exception as e:
                 detail["writeback"]["direct_error"] = repr(e)[:200]
+            detail["main_points_per_sec"] = round(dev_rate, 1)
         # probe traffic: found points pay the tier-1 flat edge gather
         # (20 B/edge), heavy-cell points additionally the tier-2 row — the
         # HBM roofline of the join (misses stop at the 96 B hash bucket)
@@ -617,6 +659,7 @@ def main():
         # is recorded loudly instead of silently dropping the lane.
         if on_tpu or force_lanes:
             try:
+                _prog("pallas lane")
                 from mosaic_tpu.core.geometry.device import pack_to_device
                 from mosaic_tpu.kernels.pip import edge_planes, pip_zone
 
@@ -664,6 +707,7 @@ def main():
         n_scale = int(os.environ.get("MOSAIC_BENCH_SCALE_POINTS", 16_000_000))
         if (on_tpu or force_lanes) and n_scale >= n_device:
             try:
+                _prog(f"scale lane ({n_scale} pts, device-generated)")
                 nb = (n_scale + batch - 1) // batch
                 lo = jnp.asarray(bbox[:2], dtype=jnp.float32)
                 span = jnp.asarray(
@@ -714,6 +758,7 @@ def main():
 
         # NumPy baseline on a subsample of the same workload (same flat
         # layout, same cell assignment — the single-core competitor)
+        _prog("numpy baseline lane")
         sub = all_pts[:n_base]
         pcells = np.asarray(
             h3.point_to_cell(jnp.asarray(sub, dtype=cell_dtype), RES)
@@ -734,6 +779,7 @@ def main():
         # chip rings — the honest JTS-codegen analog this environment can
         # run. ``vs_baseline`` is measured against THIS lane when the
         # native library builds (numpy otherwise).
+        _prog("native C++ baseline lane")
         base_kind = "numpy"
         try:
             from mosaic_tpu.core.geometry.second import (
@@ -791,6 +837,7 @@ def main():
         # instrumented step. On TPU the full fused step is timed over the
         # same staged passes; on CPU a 60k eager-path subsample checks
         # correctness only (the fused compile costs minutes there).
+        _prog("recheck lane")
         try:
             from mosaic_tpu.sql.join import (
                 CELL_MARGIN_K,
@@ -918,6 +965,7 @@ def main():
         # doctrine as the main lane: warm compile, then min over passes
         # with DISTINCT inputs (identical re-execution can return cached
         # results on this rig), dispatch RTT subtracted.
+        _prog("secondary lanes")
         try:
             sec: dict = {}
             from mosaic_tpu import functions as Fn
@@ -1013,6 +1061,7 @@ def main():
         except Exception as e:
             detail["secondary_error"] = repr(e)[:200]
 
+        _prog("all lanes done")
         obj = {
             "metric": "nyc_pip_join_throughput",
             "value": round(dev_rate, 1),

@@ -94,18 +94,53 @@ def _live_ok() -> bool:
 
 
 def run_bench() -> bool:
-    """Full bench pinned to TPU; True if a line with value>0 was captured."""
+    """Full bench pinned to TPU; True if a line with value>0 was captured.
+
+    The tunnel can die MID-bench (observed 2026-07-31: probe ok at 01:01,
+    jax.devices() hung at 01:33), so the bench checkpoints its detail dict
+    to BENCH_TPU_PARTIAL.json at every lane boundary — on a timeout that
+    partial (plus the stderr progress trail) is the salvage."""
+    partial = os.path.join(REPO, "BENCH_TPU_PARTIAL.json")
     env = dict(os.environ)
-    env.update(MOSAIC_BENCH_PLATFORM="tpu", MOSAIC_BENCH_NO_REEXEC="1")
+    env.update(MOSAIC_BENCH_PLATFORM="tpu", MOSAIC_BENCH_NO_REEXEC="1",
+               MOSAIC_BENCH_PARTIAL=partial)
+    try:  # a stale partial from a previous run must never pose as salvage
+        os.unlink(partial)
+    except OSError:
+        pass
     t0 = time.time()
+    r = None
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
-            env=env, timeout=1800, capture_output=True, text=True, cwd=REPO,
+            env=env, timeout=3600, capture_output=True, text=True, cwd=REPO,
         )
         line = json.loads(r.stdout.strip().splitlines()[-1])
+        try:  # run completed: its checkpoint is not salvage evidence
+            os.unlink(partial)
+        except OSError:
+            pass
     except Exception as e:  # noqa: BLE001 — any failure is just a trail entry
-        log({"outcome": f"bench_fail:{e!r}"[:200], "bench_s": round(time.time() - t0, 1)})
+        rec = {"outcome": f"bench_fail:{e!r}"[:200],
+               "bench_s": round(time.time() - t0, 1)}
+        # TimeoutExpired carries stderr on the exception; for post-exit
+        # failures (empty stdout after an OOM kill, bad JSON) it lives on
+        # the CompletedProcess instead
+        err = getattr(e, "stderr", None) or (r.stderr if r else None)
+        if err:
+            if isinstance(err, bytes):
+                err = err.decode(errors="replace")
+            marks = [ln for ln in err.splitlines() if ln.startswith("[bench")]
+            rec["progress_tail"] = marks[-3:]
+        if os.path.exists(partial):  # preserve the salvage per attempt
+            stamp = time.strftime("%m%d_%H%M%S")
+            try:
+                os.replace(partial,
+                           os.path.join(REPO, f"BENCH_TPU_PARTIAL_{stamp}.json"))
+                rec["partial_saved"] = f"BENCH_TPU_PARTIAL_{stamp}.json"
+            except OSError:
+                pass
+        log(rec)
         return False
     line.setdefault("detail", {})["bench_wall_s"] = round(time.time() - t0, 1)
     stamp = time.strftime("%m%d_%H%M%S")
