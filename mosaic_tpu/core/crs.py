@@ -449,6 +449,58 @@ def laea_inverse(p, en, xp=np):
     return xp.stack([lon, lat], axis=-1)
 
 
+def cea_forward(p, lonlat, xp=np):
+    """Cylindrical equal-area (Lambert/Behrmann/EASE-Grid 2.0; Snyder 10,
+    EPSG method 9835). ``lat_ts`` sets the standard parallel."""
+    a, e, lat_ts, lon0, fe, fn = p
+    st = math.sin(lat_ts)
+    k0 = math.cos(lat_ts) / math.sqrt(1 - e * e * st * st)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    q = _q_fn(lat, e, xp)
+    x = fe + a * k0 * (lon - lon0)
+    y = fn + a * q / (2.0 * k0)
+    return xp.stack([x, y], axis=-1)
+
+
+def cea_inverse(p, en, xp=np):
+    a, e, lat_ts, lon0, fe, fn = p
+    st = math.sin(lat_ts)
+    k0 = math.cos(lat_ts) / math.sqrt(1 - e * e * st * st)
+    q = 2.0 * k0 * (en[..., 1] - fn) / a
+    lat = _phi_from_q(q, e, xp)
+    lon = lon0 + (en[..., 0] - fe) / (a * k0)
+    return xp.stack([lon, lat], axis=-1)
+
+
+def eqc_forward(p, lonlat, xp=np):
+    """Equidistant cylindrical / Plate Carree (EPSG method 1028,
+    ellipsoidal: true-scale parallel ``lat_ts``, meridian distance as
+    northing; the sphere case falls out with e = 0)."""
+    a, e, lat_ts, lat0, lon0, fe, fn = p
+    st = math.sin(lat_ts)
+    nu1c = a * math.cos(lat_ts) / math.sqrt(1 - e * e * st * st)
+    arc = _poly_arc_params(a, e)
+    m0 = _tm_meridional_arc(arc, np.asarray(lat0), np)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    x = fe + nu1c * (lon - lon0)
+    y = fn + _tm_meridional_arc(arc, lat, xp) - m0
+    return xp.stack([x, y], axis=-1)
+
+
+def eqc_inverse(p, en, xp=np, iters: int = 6):
+    a, e, lat_ts, lat0, lon0, fe, fn = p
+    st = math.sin(lat_ts)
+    nu1c = a * math.cos(lat_ts) / math.sqrt(1 - e * e * st * st)
+    arc = _poly_arc_params(a, e)
+    m0 = _tm_meridional_arc(arc, np.asarray(lat0), np)
+    m = en[..., 1] - fn + m0
+    lat = m / a  # fixed-count footpoint iteration, as tm_inverse
+    for _ in range(iters):
+        lat = lat + (m - _tm_meridional_arc(arc, lat, xp)) / a
+    lon = lon0 + (en[..., 0] - fe) / nu1c
+    return xp.stack([lon, lat], axis=-1)
+
+
 def _sterea_consts(p):
     """Oblique-stereographic constants (EPSG Guidance Note 7-2, 'Oblique
     Stereographic' — the double projection onto the conformal sphere)."""

@@ -33,6 +33,10 @@ from .crs import (
     _helmert,
     cass_forward,
     cass_inverse,
+    cea_forward,
+    cea_inverse,
+    eqc_forward,
+    eqc_inverse,
     eqdc_forward,
     eqdc_inverse,
     laea_forward,
@@ -74,6 +78,7 @@ ELLIPSOIDS: dict[str, tuple[float, float]] = {
     "intl": (6378388.0, 297.0),
     "clrk66": (6378206.4, 294.9786982),
     "clrk80ign": (6378249.2, 293.4660213),
+    "mod_airy": (6377340.189, 299.3249646),
     "krass": (6378245.0, 298.3),
     "WGS72": (6378135.0, 298.26),
     "aust_SA": (6378160.0, 298.25),
@@ -106,7 +111,7 @@ UNITS: dict[str, float] = {
 _SUPPORTED_PROJ = (
     "utm, tmerc (incl. +axis=wsu south-orientated), merc, lcc, aea, eqdc, "
     "laea, stere (polar), sterea, somerc, omerc (Hotine A/B), krovak, "
-    "cass, poly, nzmg, longlat/latlong"
+    "cass, poly, nzmg, cea, eqc, longlat/latlong"
 )
 
 
@@ -327,6 +332,16 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
         return ProjCRS(
             "laea", (a, e, lat0, lon0, fe, fn), a, e2, shift, to_meter, area
         )
+    if proj == "cea":
+        lat_ts = _R(_f(kv, "lat_ts", 0.0))
+        if k0 is not None:
+            raise ValueError("+proj=cea takes +lat_ts, not +k_0")
+        p = (a, e, lat_ts, lon0, fe, fn)
+        return ProjCRS("cea", p, a, e2, shift, to_meter, area)
+    if proj == "eqc":
+        lat_ts = _R(_f(kv, "lat_ts", 0.0))
+        p = (a, e, lat_ts, lat0, lon0, fe, fn)
+        return ProjCRS("eqc", p, a, e2, shift, to_meter, area)
     if proj == "poly":
         p = (a, e, lat0, lon0, fe, fn)
         return ProjCRS("poly", p, a, e2, shift, to_meter, area)
@@ -373,6 +388,8 @@ parse_proj.__doc__ = parse_proj.__doc__.format(supported=_SUPPORTED_PROJ)
 _FWD = {
     "nzmg": nzmg_forward,
     "cass": cass_forward,
+    "cea": cea_forward,
+    "eqc": eqc_forward,
     "eqdc": eqdc_forward,
     "omerc": omerc_forward,
     "tm_south": tm_south_forward,
@@ -390,6 +407,8 @@ _FWD = {
 _INV = {
     "nzmg": nzmg_inverse,
     "cass": cass_inverse,
+    "cea": cea_inverse,
+    "eqc": eqc_inverse,
     "eqdc": eqdc_inverse,
     "omerc": omerc_inverse,
     "tm_south": tm_south_inverse,
@@ -505,6 +524,8 @@ def default_area(crs: ProjCRS) -> tuple[float, float, float, float]:
             if south
             else (-180.0, 60.0, 180.0, 90.0)
         )
+    if crs.kind in ("cea", "eqc"):  # world cylindrical grids
+        return (-180.0, -86.0, 180.0, 86.0)
     raise ValueError(f"no default area for projection kind {crs.kind!r}")
 
 
@@ -782,6 +803,135 @@ for _z in range(17, 26):
         f"+proj=utm +zone={_z} +south " + _GRS,
         (_z * 6 - 186.0, -35.0, _z * 6 - 180.0, 5.0),
     )
+
+# world cylindrical grids: equidistant (EPSG method 1028; 4087 ellipsoidal,
+# 4088/32662 spherical twins) and NSIDC EASE-Grid 2.0 / original EASE (cea,
+# standard parallel 30) — common raster/tile georeferencing codes
+_EPSG[4087] = (
+    "+proj=eqc +lat_ts=0 +lat_0=0 +lon_0=0 +x_0=0 +y_0=0 +ellps=WGS84",
+    (-180.0, -90.0, 180.0, 90.0),
+)
+_EPSG[4088] = (
+    "+proj=eqc +lat_ts=0 +lat_0=0 +lon_0=0 +x_0=0 +y_0=0 "
+    "+a=6371007 +b=6371007",
+    (-180.0, -90.0, 180.0, 90.0),
+)
+_EPSG[32662] = _EPSG[4087]  # deprecated "WGS 84 / Plate Carree" alias
+_EPSG[6933] = (
+    "+proj=cea +lat_ts=30 +lon_0=0 +x_0=0 +y_0=0 +ellps=WGS84",
+    (-180.0, -86.0, 180.0, 86.0),
+)
+_EPSG[3410] = (
+    "+proj=cea +lat_ts=30 +lon_0=0 +x_0=0 +y_0=0 +a=6371228 +b=6371228",
+    (-180.0, -86.0, 180.0, 86.0),
+)
+
+# Pulkovo 1942 / Gauss-Krueger zones 2..32 (EPSG 28402..28432): 6-degree
+# zones on Krassowsky 1940 with zone-prefixed false easting. Zones 31/32
+# (Chukotka) sit past the antimeridian: their central meridian and area
+# use wrapped (negative) longitudes so dl = lon - lon0 stays small.
+for _z in range(2, 33):
+    _wrap = 360.0 if _z * 6 - 3 > 180 else 0.0
+    _EPSG[28400 + _z] = (
+        f"+proj=tmerc +lat_0=0 +lon_0={_z * 6 - 3 - _wrap} +k=1 "
+        f"+x_0={_z}500000 +y_0=0 "
+        "+towgs84=23.92,-141.27,-80.9,0,0.35,0.82,-0.12 +ellps=krass",
+        (_z * 6 - 6.0 - _wrap, 35.0, _z * 6.0 - _wrap, 81.0),
+    )
+
+# WGS 72 / UTM zones 1..60 N (32201..32260) and S (32301..32360)
+for _z in range(1, 61):
+    _EPSG[32200 + _z] = (
+        f"+proj=utm +zone={_z} "
+        "+towgs84=0,0,4.5,0,0,0.554,0.2263 +ellps=WGS72",
+        (_z * 6 - 186.0, 0.0, _z * 6 - 180.0, 84.0),
+    )
+    _EPSG[32300 + _z] = (
+        f"+proj=utm +zone={_z} +south "
+        "+towgs84=0,0,4.5,0,0,0.554,0.2263 +ellps=WGS72",
+        (_z * 6 - 186.0, -80.0, _z * 6 - 180.0, 0.0),
+    )
+
+# NAD27 / UTM zones 1..22 N (26701..26722), Clarke 1866
+for _z in range(1, 23):
+    _EPSG[26700 + _z] = (
+        f"+proj=utm +zone={_z} +towgs84=-8,160,176 +ellps=clrk66",
+        (_z * 6 - 186.0, 15.0, _z * 6 - 180.0, 84.0),
+    )
+
+# ED50 / UTM zones 28..38 (23028..23038), International 1924
+for _z in range(28, 39):
+    _EPSG[23000 + _z] = (
+        f"+proj=utm +zone={_z} +towgs84=-87,-98,-121 +ellps=intl",
+        (_z * 6 - 186.0, 25.0, _z * 6 - 180.0, 84.0),
+    )
+
+# AGD66 / AMG zones 48..58 (20248..20258) and AGD84 / AMG (20348..20358):
+# the pre-GDA Australian Map Grid on the Australian National Spheroid
+for _z in range(48, 59):
+    _EPSG[20200 + _z] = (
+        f"+proj=utm +zone={_z} +south +towgs84=-133,-48,148 +ellps=aust_SA",
+        (_z * 6 - 186.0, -45.0, _z * 6 - 180.0, -8.0),
+    )
+    _EPSG[20300 + _z] = (
+        f"+proj=utm +zone={_z} +south +towgs84=-134,-48,149 +ellps=aust_SA",
+        (_z * 6 - 186.0, -45.0, _z * 6 - 180.0, -8.0),
+    )
+
+# SAD69 / UTM zones 18..22 N (29168..29172) and 17..25 S (29187..29195)
+for _z in range(18, 23):
+    _EPSG[29150 + _z] = (
+        f"+proj=utm +zone={_z} +towgs84=-57,1,-41 +ellps=aust_SA",
+        (_z * 6 - 186.0, 0.0, _z * 6 - 180.0, 13.0),
+    )
+for _z in range(17, 26):
+    _EPSG[29170 + _z] = (
+        f"+proj=utm +zone={_z} +south +towgs84=-57,1,-41 +ellps=aust_SA",
+        (_z * 6 - 186.0, -35.0, _z * 6 - 180.0, 5.0),
+    )
+
+# Japan Plane Rectangular CS zones I..XIX: per-zone TM origins (published
+# JGD survey law values), k = 0.9999. Three datum generations share the
+# grid: Tokyo (30161+z), JGD2000 (2443+z), JGD2011 (6669+z).
+_JPRCS = [
+    (33.0, 129.5), (33.0, 131.0), (36.0, 132.0 + 1.0 / 6.0), (33.0, 133.5),
+    (36.0, 134.0 + 1.0 / 3.0), (36.0, 136.0), (36.0, 137.0 + 1.0 / 6.0),
+    (36.0, 138.5), (36.0, 139.0 + 5.0 / 6.0), (40.0, 140.0 + 5.0 / 6.0),
+    (44.0, 140.25), (44.0, 142.25), (44.0, 144.25), (26.0, 142.0),
+    (26.0, 127.5), (26.0, 124.0), (26.0, 131.0), (20.0, 136.0),
+    (26.0, 154.0),
+]
+for _z, (_la, _lo) in enumerate(_JPRCS):
+    _jp_area = (_lo - 2.0, max(_la - 4.0, 17.0), _lo + 2.0, min(_la + 4.0, 46.0))
+    _tm = f"+proj=tmerc +lat_0={_la} +lon_0={_lo} +k=0.9999 +x_0=0 +y_0=0 "
+    _EPSG[30161 + _z] = (
+        _tm + "+towgs84=-146.414,507.337,680.507 +ellps=bessel", _jp_area
+    )
+    _EPSG[2443 + _z] = (_tm + _GRS, _jp_area)
+    _EPSG[6669 + _z] = (_tm + _GRS, _jp_area)
+
+# Irish grids: TM65/TM75 Irish Grid (Airy Modified) + IRENET95 ITM
+_IRISH_GRID = (
+    "+proj=tmerc +lat_0=53.5 +lon_0=-8 +k=1.000035 +x_0=200000 "
+    "+y_0=250000 "
+    "+towgs84=482.5,-130.6,564.6,-1.042,-0.214,-0.631,8.15 +ellps=mod_airy",
+    (-10.93, 51.39, -5.34, 55.43),
+)
+_EPSG[29902] = _IRISH_GRID  # TM65 / Irish Grid
+_EPSG[29903] = _IRISH_GRID  # TM75 / Irish Grid (same projection params)
+_EPSG[29900] = _IRISH_GRID  # deprecated original code
+_EPSG[2157] = (
+    "+proj=tmerc +lat_0=53.5 +lon_0=-8 +k=0.99982 +x_0=600000 "
+    "+y_0=750000 " + _GRS,
+    (-10.93, 51.39, -5.34, 55.43),
+)
+
+# GGRS87 / Greek Grid (the +datum entry carries the published shift)
+_EPSG[2100] = (
+    "+proj=tmerc +lat_0=0 +lon_0=24 +k=0.9996 +x_0=500000 +y_0=0 "
+    "+datum=GGRS87",
+    (19.57, 34.88, 28.30, 41.75),
+)
 
 # the Ferro-referenced original S-JTSK code shares 5514's definition
 _EPSG[2065] = _EPSG[5514]

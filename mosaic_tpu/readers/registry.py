@@ -66,11 +66,12 @@ def _fmt_raster_to_grid(path, **kw):
 def _fmt_csv_points(path, **kw):
     from .vector import read_points_csv
 
+    mr = kw.get("maxRows")
     return read_points_csv(
         path,
         lon_col=kw.get("lonCol", "pickup_longitude"),
         lat_col=kw.get("latCol", "pickup_latitude"),
-        max_rows=kw.get("maxRows"),
+        max_rows=None if mr is None else int(mr),
     )
 
 
@@ -134,6 +135,24 @@ def _fmt_gpx(path, **kw):
     return read_gpx(path)
 
 
+def _fmt_topojson(path, **kw):
+    from .topojson import read_topojson
+
+    return read_topojson(path, layer=kw.get("layer"))
+
+
+def _fmt_csv_wkt(path, **kw):
+    from .vector import read_wkt_csv
+
+    mr = kw.get("maxRows")
+    return read_wkt_csv(
+        path,
+        wkt_col=kw.get("wktCol", "wkt"),
+        srid=int(kw.get("srid", 4326)),
+        max_rows=None if mr is None else int(mr),
+    )
+
+
 _FORMATS: dict[str, Callable] = {
     "kml": _fmt_kml,
     "gml": _fmt_gml,
@@ -152,6 +171,8 @@ _FORMATS: dict[str, Callable] = {
     "mapinfo": _fmt_mif,  # OGR "MapInfo File" driver name analog
     "mif": _fmt_mif,
     "dxf": _fmt_dxf,
+    "topojson": _fmt_topojson,
+    "csv_wkt": _fmt_csv_wkt,  # OGR "CSV" driver with a WKT geometry field
 }
 
 

@@ -210,15 +210,16 @@ def read_shapefile(path: str) -> VectorTable:
 # --------------------------------------------------------------- geojson
 
 
-def read_geojson(path_or_obj) -> VectorTable:
-    """GeoJSON FeatureCollection -> VectorTable (properties as columns)."""
-    geom, props = read_feature_collection(path_or_obj)
+def props_to_columns(props: "list[dict | None]") -> dict[str, np.ndarray]:
+    """Feature properties -> typed columns: all-numeric keys become float
+    arrays (None -> NaN), everything else an object array. Shared by the
+    GeoJSON and TopoJSON readers so both type columns identically."""
     keys: list[str] = []
     for pr in props:
         for k in pr or {}:
             if k not in keys:
                 keys.append(k)
-    cols = {}
+    cols: dict[str, np.ndarray] = {}
     for k in keys:
         vals = [(pr or {}).get(k) for pr in props]
         if all(isinstance(v, (int, float, type(None))) and not isinstance(v, bool) for v in vals):
@@ -227,7 +228,13 @@ def read_geojson(path_or_obj) -> VectorTable:
             )
         else:
             cols[k] = np.asarray(vals, dtype=object)
-    return VectorTable(geometry=geom, columns=cols)
+    return cols
+
+
+def read_geojson(path_or_obj) -> VectorTable:
+    """GeoJSON FeatureCollection -> VectorTable (properties as columns)."""
+    geom, props = read_feature_collection(path_or_obj)
+    return VectorTable(geometry=geom, columns=props_to_columns(props))
 
 
 # ------------------------------------------------------------ CSV points
@@ -262,6 +269,41 @@ def read_points_csv(
         geometry=geom,
         columns={lon_col: np.asarray(lons), lat_col: np.asarray(lats)},
     )
+
+
+def read_wkt_csv(
+    path: str,
+    wkt_col: str = "wkt",
+    srid: int = 4326,
+    max_rows: "int | None" = None,
+) -> VectorTable:
+    """CSV with a WKT geometry column (OGR "CSV" driver semantics: the
+    GEOM_POSSIBLE_NAMES field parses as WKT, other columns ride along)."""
+    import csv
+
+    from ..core.geometry.wkt import from_wkt
+
+    wkts: list[str] = []
+    rows: list[dict] = []
+    with open(path, newline="") as f:
+        rd = csv.DictReader(f)
+        if rd.fieldnames is None or wkt_col not in rd.fieldnames:
+            raise ValueError(
+                f"no column {wkt_col!r} in {path}; have {rd.fieldnames}"
+            )
+        for i, row in enumerate(rd):
+            if max_rows is not None and i >= max_rows:
+                break
+            wkts.append(row.pop(wkt_col) or "GEOMETRYCOLLECTION EMPTY")
+            rows.append(row)
+    geom = from_wkt(wkts, srid=srid)
+    keys = rd.fieldnames or []
+    cols = {
+        k: np.asarray([r.get(k) for r in rows], dtype=object)
+        for k in keys
+        if k != wkt_col
+    }
+    return VectorTable(geometry=geom, columns=cols)
 
 
 # ------------------------------------------------- multiread (chunked)
@@ -348,4 +390,8 @@ def open_any(path: str) -> VectorTable:
         from .geopackage import read_geopackage
 
         return read_geopackage(path)
+    if s.endswith(".topojson"):
+        from .topojson import read_topojson
+
+        return read_topojson(path)
     raise ValueError(f"no reader for {path}")

@@ -43,6 +43,12 @@ _ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [
     26931, 3375, 3376, 29873, 28191, 24500, 102031, 102026, 2048, 2053,
     # round-5 additions: NZMG, sphere-LAEA, POSGAR south-pole-origin GK
     27200, 2163, 5343, 5345, 5349,
+    # round-5 breadth: world eqc/cea grids, Pulkovo GK (incl. the wrapped
+    # antimeridian zone 32), WGS72/NAD27/ED50 UTM, AGD66/84 AMG, SAD69
+    # UTM, Japan zones (all three datum generations), Irish grids, Greek
+    4087, 4088, 6933, 3410, 28407, 28422, 28432, 32230, 32330, 26710,
+    23031, 20255, 20355, 29171, 29193, 30169, 2451, 6677, 29902, 2157,
+    2100,
 ]
 
 
@@ -527,3 +533,53 @@ def test_datum_shift_geographic_crs():
     assert 1e-4 < d.max() < 3e-3  # offset is O(100 m), not 0, not huge
     back = crs.from_wgs84(ll_wgs, 4277)
     assert np.abs(back - ll_osgb).max() < 1e-7
+
+
+def test_eqc_world_grid_anchors():
+    """EPSG 4087 (method 1028): the antimeridian easting is the WGS84
+    semi-circumference and the pole northing is the meridian quadrant —
+    both published constants of the grid."""
+    en = crs.from_wgs84(np.array([[180.0, 0.0], [0.0, 90.0]]), 4087)
+    assert abs(en[0, 0] - 20037508.3428) < 0.01
+    assert abs(en[1, 1] - 10001965.7293) < 0.01
+    # spherical twin: both extents are just R*pi(/2)
+    en_s = crs.from_wgs84(np.array([[180.0, 0.0], [0.0, 90.0]]), 4088)
+    assert abs(en_s[0, 0] - 6371007 * np.pi) < 1e-6
+    assert abs(en_s[1, 1] - 6371007 * np.pi / 2) < 1e-6
+
+
+def test_cea_ease_grid2_extent_and_equal_area():
+    """EASE-Grid 2.0 (EPSG 6933): the published grid half-width is
+    17367530.45 m; equal-area means d(y)/d(q) is constant — assert the
+    authalic northing spacing, not linear latitude spacing."""
+    en = crs.from_wgs84(np.array([[180.0, 0.0]]), 6933)
+    assert abs(en[0, 0] - 17367530.45) < 0.01
+    # area preservation: strip [0,30]x[lat,lat+d] areas shrink with cos(lat)
+    lats = np.array([[10.0, 20.0], [10.0, 21.0], [10.0, 60.0], [10.0, 61.0]])
+    ys = crs.from_wgs84(lats[:, ::-1] * 0 + np.stack(
+        [np.zeros(4), lats[:, 1]], -1), 6933)[:, 1]
+    strip_low = ys[1] - ys[0]
+    strip_high = ys[3] - ys[2]
+    # cos(60.5)/cos(20.5) ~ 0.525 — equal-area compression with latitude
+    assert 0.4 < strip_high / strip_low < 0.6
+
+
+def test_japan_zone_origins_map_to_zero():
+    """JGD2000/JGD2011 Plane Rectangular origins (no datum shift) project
+    to exactly (0,0); the Tokyo-datum twin is offset by its Helmert."""
+    origins = {2443: (129.5, 33.0), 2451: (139.0 + 5.0 / 6.0, 36.0),
+               6687: (154.0, 26.0)}
+    for srid, (lo, la) in origins.items():
+        en = crs.from_wgs84(np.array([[lo, la]]), srid)
+        assert np.abs(en).max() < 1e-6, (srid, en)
+    en_tokyo = crs.from_wgs84(np.array([[139.0 + 5.0 / 6.0, 36.0]]), 30169)
+    assert 200 < float(np.hypot(*en_tokyo[0])) < 1000  # Tokyo datum offset
+
+
+def test_pulkovo_gk_false_easting_prefix():
+    """Pulkovo GK zone N prefixes the false easting with N*1e6; a point on
+    the central meridian lands near x = N*1e6 + 500000."""
+    for zone, srid in ((7, 28407), (32, 28432)):
+        lon0 = zone * 6 - 3 - (360 if zone * 6 - 3 > 180 else 0)
+        en = crs.from_wgs84(np.array([[lon0, 55.0]]), srid)
+        assert abs(en[0, 0] - (zone * 1e6 + 500000)) < 300  # datum shift

@@ -879,3 +879,121 @@ def test_shapefile_all_shape_types_and_dbf_typing(tmp_path):
         False, True,
     ]
     assert t.columns["name"][0] == "r0"
+
+
+# ---------------------------------------------------------------- TopoJSON
+def test_topojson_quantized_shared_arc(tmp_path):
+    """Two unit squares sharing a delta-encoded arc; the right square
+    traverses it reversed (~0). Decoded areas and the junction-point
+    dedup are asserted against hand-computed coordinates."""
+    import json
+
+    from mosaic_tpu import functions as F
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.registry import read
+
+    topo = {
+        "type": "Topology",
+        "transform": {"scale": [0.001, 0.001], "translate": [0.0, 0.0]},
+        "arcs": [
+            [[1000, 0], [0, 1000]],                                # shared
+            [[1000, 1000], [-1000, 0], [0, -1000], [1000, 0]],     # left
+            [[1000, 0], [1000, 0], [0, 1000], [-1000, 0]],         # right
+        ],
+        "objects": {
+            "squares": {
+                "type": "GeometryCollection",
+                "geometries": [
+                    {"type": "Polygon", "arcs": [[0, 1]],
+                     "properties": {"name": "L"}},
+                    {"type": "Polygon", "arcs": [[2, -1]],
+                     "properties": {"name": "R"}},
+                ],
+            },
+            "site": {"type": "Point", "coordinates": [500, 500],
+                     "properties": {"name": "P"}},
+        },
+    }
+    p = tmp_path / "t.topojson"
+    p.write_text(json.dumps(topo))
+    t = read("topojson").load(str(p))
+    assert len(t) == 3
+    g = t.geometry
+    assert g.geometry_type(0) == GeometryType.POLYGON
+    # left ring: stitched (1,0),(1,1),(0,1),(0,0) — junction appears once
+    np.testing.assert_allclose(
+        g.geom_xy(0), [[1, 0], [1, 1], [0, 1], [0, 0]], atol=1e-12
+    )
+    areas = np.asarray(F.st_area(g))
+    np.testing.assert_allclose(areas[:2], [1.0, 1.0], atol=1e-12)
+    # quantized Point positions are absolute, not deltas
+    assert g.geometry_type(2) == GeometryType.POINT
+    np.testing.assert_allclose(g.geom_xy(2), [[0.5, 0.5]], atol=1e-12)
+    assert list(t.columns["layer"]) == ["squares", "squares", "site"]
+    assert list(t.columns["name"]) == ["L", "R", "P"]
+    # layer selection mirrors OGR's per-object layers
+    only = read("topojson").option("layer", "site").load(str(p))
+    assert len(only) == 1 and only.columns["layer"][0] == "site"
+    with pytest.raises(ValueError, match="no such TopoJSON object"):
+        read("topojson").option("layer", "nope").load(str(p))
+
+
+def test_topojson_unquantized_hole_line_and_open_any(tmp_path):
+    """No transform: arc positions are absolute floats (no cumsum). A
+    holed polygon and a two-arc line round-trip; open_any dispatches on
+    the .topojson suffix."""
+    import json
+
+    from mosaic_tpu import functions as F
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.vector import open_any
+
+    topo = {
+        "type": "Topology",
+        "arcs": [
+            [[0.0, 0.0], [4.0, 0.0], [4.0, 4.0], [0.0, 4.0], [0.0, 0.0]],
+            [[1.0, 1.0], [1.0, 2.0], [2.0, 2.0], [2.0, 1.0], [1.0, 1.0]],
+            [[0.0, 0.0], [1.0, 1.0]],
+            [[1.0, 1.0], [3.0, 1.0]],
+        ],
+        "objects": {
+            "poly": {"type": "Polygon", "arcs": [[0], [1]]},
+            "path": {"type": "LineString", "arcs": [2, 3]},
+        },
+    }
+    p = tmp_path / "h.topojson"
+    p.write_text(json.dumps(topo))
+    t = open_any(str(p))
+    assert len(t) == 2
+    area = float(np.asarray(F.st_area(t.geometry.take([0])))[0])
+    assert abs(area - (16.0 - 1.0)) < 1e-12
+    assert t.geometry.geometry_type(1) == GeometryType.LINESTRING
+    np.testing.assert_allclose(
+        t.geometry.geom_xy(1), [[0, 0], [1, 1], [3, 1]], atol=1e-12
+    )
+
+
+def test_csv_wkt_reader(tmp_path):
+    """OGR CSV-driver analog: a WKT geometry column plus attributes."""
+    from mosaic_tpu import functions as F
+    from mosaic_tpu.core.types import GeometryType
+    from mosaic_tpu.readers.registry import read
+
+    p = tmp_path / "t.csv"
+    p.write_text(
+        'id,wkt,score\n'
+        '1,"POINT (3 4)",0.5\n'
+        '2,"POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))",1.5\n'
+        '3,"LINESTRING (0 0, 1 1)",2.5\n'
+    )
+    t = read("csv_wkt").load(str(p))
+    assert len(t) == 3
+    g = t.geometry
+    assert g.geometry_type(0) == GeometryType.POINT
+    assert g.geometry_type(1) == GeometryType.POLYGON
+    assert float(np.asarray(F.st_area(g.take([1])))[0]) == 4.0
+    assert int(g.srid[0]) == 4326
+    assert list(t.columns["id"]) == ["1", "2", "3"]
+    assert list(t.columns["score"]) == ["0.5", "1.5", "2.5"]
+    with pytest.raises(ValueError, match="no column"):
+        read("csv_wkt").option("wktCol", "geom").load(str(p))
