@@ -10,7 +10,7 @@ from __future__ import annotations
 from typing import Callable
 
 
-__all__ = ["try_sql"]
+__all__ = ["try_sql", "try_sql_columnar"]
 
 
 def try_sql(fn: Callable, *columns, **kwargs):
@@ -37,4 +37,48 @@ def try_sql(fn: Callable, *columns, **kwargs):
             results[i] = fn(*args, **kwargs)
         except Exception as e:  # noqa: BLE001 — per-row isolation is the point
             errors[i] = f"{type(e).__name__}: {e}"
+    return results, errors
+
+
+def try_sql_columnar(fn: Callable, *columns, **kwargs):
+    """Columnar ``try_sql``: same null-plus-error contract, batch cost.
+
+    ``fn`` takes whole column slices and returns a sequence of per-row
+    results (any of this package's columnar functions qualifies). The
+    clean path is ONE vectorized call; on failure the column bisects, so
+    isolating k bad rows among n costs O(k log n) vectorized calls
+    instead of the n Python-level calls of :func:`try_sql`. Failing rows
+    come back as None with the row's error message, exactly like the
+    reference's TrySql error column (`expressions/util/TrySql.scala:
+    12-71`).
+    """
+    n = len(columns[0])
+    results: list = [None] * n
+    errors: list = [None] * n
+
+    def run(lo: int, hi: int) -> None:
+        cols = [c[lo:hi] for c in columns]
+        try:
+            # materialize INSIDE the try: a lazy fn (generator/map) defers
+            # its failure to iteration, which must still bisect; a wrong
+            # output length would silently misalign rows
+            out = list(fn(*cols, **kwargs))
+            if len(out) != hi - lo:
+                raise ValueError(
+                    f"columnar fn returned {len(out)} results for "
+                    f"{hi - lo} rows"
+                )
+        except Exception as e:  # noqa: BLE001 — isolate by bisection
+            if hi - lo == 1:
+                errors[lo] = f"{type(e).__name__}: {e}"
+                return
+            mid = (lo + hi) // 2
+            run(lo, mid)
+            run(mid, hi)
+            return
+        for i, v in enumerate(out):
+            results[lo + i] = v
+
+    if n:
+        run(0, n)
     return results, errors

@@ -240,6 +240,10 @@ def _format_specs(e):
         "try_sql": lambda: F.try_sql(
             lambda w: float(F.st_area(W.from_wkt([w]))[0]), F.st_aswkt(simple)
         ),
+        "try_sql_columnar": lambda: F.try_sql_columnar(
+            lambda ws: [float(a) for a in F.st_area(W.from_wkt(list(ws)))],
+            F.st_aswkt(simple),
+        ),
     }
 
 

@@ -285,6 +285,58 @@ def test_try_sql():
     assert err[0] is None and "Error" in (err[1] or "Error")
 
 
+def test_try_sql_columnar_bisects_bad_rows():
+    """Clean path is one vectorized call; bad rows isolate by bisection
+    with the same None + error-message contract as try_sql."""
+    calls = []
+
+    def area_col(wkts):
+        calls.append(len(wkts))
+        return [float(a) for a in F.st_area(list(wkts), backend="oracle")]
+
+    # all-clean: exactly one columnar call
+    res, err = F.try_sql_columnar(area_col, [SQUARE, SQUARE, SQUARE])
+    assert res == [16.0, 16.0, 16.0] and err == [None] * 3
+    assert calls == [3]
+
+    # two bad rows among six: every good row still evaluated, both bad
+    # rows carry messages, and the call count stays logarithmic (< n+1)
+    calls.clear()
+    col = [SQUARE, "NOT A WKT", SQUARE, SQUARE, "POLYGON((", SQUARE]
+    res, err = F.try_sql_columnar(area_col, col)
+    assert [r == 16.0 for r in res] == [True, False, True, True, False, True]
+    assert err[1] is not None and err[4] is not None
+    assert sum(e is None for e in err) == 4
+
+    # the bisection win shows at scale: one bad row in 4096 isolates in
+    # O(log n) columnar calls, nowhere near the 4096 of per-row try_sql
+    calls.clear()
+    big = [SQUARE] * 4096
+    big[1777] = "NOT A WKT"
+    res, err = F.try_sql_columnar(area_col, big)
+    assert res[1776] == 16.0 and res[1777] is None and err[1777]
+    assert len(calls) <= 30
+
+    # empty column: no calls, empty outputs
+    calls.clear()
+    assert F.try_sql_columnar(area_col, []) == ([], [])
+    assert calls == []
+
+    # a lazy fn defers its failure to iteration: still isolated per-row
+    res, err = F.try_sql_columnar(
+        lambda ws: (float(a) for a in F.st_area(list(ws), backend="oracle")),
+        [SQUARE, "NOT A WKT"],
+    )
+    assert res == [16.0, None] and err[0] is None and err[1]
+
+    # wrong-length output is an error, not silent row misalignment (a
+    # fixed-length fn recovers by bisection down to rows where its length
+    # happens to be right; an always-empty fn errors on every row)
+    res, err = F.try_sql_columnar(lambda ws: [], [SQUARE, SQUARE])
+    assert res == [None, None]
+    assert all("columnar fn returned" in e for e in err)
+
+
 def test_context_registry():
     ctx = MosaicContext.build("BNG", geometry_backend="oracle")
     assert ctx.index_system.name == "BNG"
