@@ -141,6 +141,12 @@ def _fmt_topojson(path, **kw):
     return read_topojson(path, layer=kw.get("layer"))
 
 
+def _fmt_flatgeobuf(path, **kw):
+    from .flatgeobuf import read_flatgeobuf
+
+    return read_flatgeobuf(path)
+
+
 def _fmt_csv_wkt(path, **kw):
     from .vector import read_wkt_csv
 
@@ -173,6 +179,7 @@ _FORMATS: dict[str, Callable] = {
     "dxf": _fmt_dxf,
     "topojson": _fmt_topojson,
     "csv_wkt": _fmt_csv_wkt,  # OGR "CSV" driver with a WKT geometry field
+    "flatgeobuf": _fmt_flatgeobuf,
 }
 
 

@@ -394,4 +394,8 @@ def open_any(path: str) -> VectorTable:
         from .topojson import read_topojson
 
         return read_topojson(path)
+    if s.endswith(".fgb"):
+        from .flatgeobuf import read_flatgeobuf
+
+        return read_flatgeobuf(path)
     raise ValueError(f"no reader for {path}")
