@@ -68,6 +68,10 @@ _T0 = time.perf_counter()
 _PARTIAL_PATH = os.environ.get("MOSAIC_BENCH_PARTIAL")
 
 
+class _QuickSkip(Exception):
+    """Raised inside optional lanes when MOSAIC_BENCH_QUICK is set."""
+
+
 def _prog(msg: str) -> None:
     """Stderr progress mark (stdout carries only the JSON line). The
     tunnel makes some compiles minutes-long; without these marks a slow
@@ -375,6 +379,13 @@ def main():
         # MOSAIC_BENCH_FORCE_TPU_LANES exercises the TPU-only lanes on CPU
         # (code-path testing; the numbers are meaningless there)
         force_lanes = bool(os.environ.get("MOSAIC_BENCH_FORCE_TPU_LANES"))
+        # quick mode: headline + writeback autotune + pallas + baselines
+        # only — the watcher banks a number inside a short tunnel window
+        # before attempting the full lane set (scale is skipped separately
+        # via MOSAIC_BENCH_SCALE_POINTS=0)
+        quick = bool(os.environ.get("MOSAIC_BENCH_QUICK"))
+        if quick:
+            detail["quick"] = True
         n_device = int(
             os.environ.get(
                 "MOSAIC_BENCH_POINTS", 4_000_000 if on_tpu else 1_000_000
@@ -837,8 +848,10 @@ def main():
         # instrumented step. On TPU the full fused step is timed over the
         # same staged passes; on CPU a 60k eager-path subsample checks
         # correctness only (the fused compile costs minutes there).
-        _prog("recheck lane")
+        _prog("recheck lane" + (" (skipped: quick)" if quick else ""))
         try:
+            if quick:
+                raise _QuickSkip()
             from mosaic_tpu.sql.join import (
                 CELL_MARGIN_K,
                 EDGE_BAND_K,
@@ -956,6 +969,8 @@ def main():
                     float((m[:, 0] < km_val).mean()), 5
                 )
                 rc["mode"] = "cpu_subsample_60k"
+        except _QuickSkip:
+            detail["recheck"] = {"skipped": "quick"}
         except Exception as e:  # the lane must not kill the bench
             detail["recheck_error"] = repr(e)[:300]
 
@@ -965,8 +980,10 @@ def main():
         # doctrine as the main lane: warm compile, then min over passes
         # with DISTINCT inputs (identical re-execution can return cached
         # results on this rig), dispatch RTT subtracted.
-        _prog("secondary lanes")
+        _prog("secondary lanes" + (" (skipped: quick)" if quick else ""))
         try:
+            if quick:
+                raise _QuickSkip()
             sec: dict = {}
             from mosaic_tpu import functions as Fn
             from mosaic_tpu.datasets import synthetic_zones
@@ -1058,6 +1075,8 @@ def main():
             sec["ship2ship_join_host_s"] = round(min(s2s_times), 3)
             sec["ship2ship_pairs"] = int(np.asarray(prs).shape[0])
             detail["secondary"] = sec  # only a complete record is exposed
+        except _QuickSkip:
+            detail["secondary"] = {"skipped": "quick"}
         except Exception as e:
             detail["secondary_error"] = repr(e)[:200]
 

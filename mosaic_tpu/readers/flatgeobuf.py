@@ -20,7 +20,7 @@ import struct
 
 import numpy as np
 
-from ..core.types import GeometryBuilder, GeometryType, open_ring
+from ..core.types import GeometryBuilder, GeometryType, close_ring, open_ring
 from .vector import VectorTable
 
 MAGIC = b"fgb\x03fgb\x00"
@@ -404,14 +404,16 @@ class _Builder:
 
 def _geometry_fields(b: _Builder, col, g: int, gtype: GeometryType):
     """Build the Geometry table contents for geometry ``g``; returns the
-    table's field dict (coordinates closed back up for polygon rings)."""
+    table's field dict (coordinates closed back up for polygon rings,
+    Z riding the parallel slot-2 vector when the geometry carries it)."""
     fields: dict[int, tuple] = {}
     t = gtype
+    with_z = col.has_z(g)
     if t == GeometryType.MULTIPOLYGON:
         parts = []
         for p in col.geom_parts(g):
             sub: dict[int, tuple] = {}
-            _rings_into(b, col, [p], sub)
+            _rings_into(b, col, [p], sub, with_z)
             parts.append(b.table(sub))
         fields[7] = ("offset", b.vector_offsets(parts))
         fields[6] = ("scalar", "B", 6)
@@ -422,7 +424,7 @@ def _geometry_fields(b: _Builder, col, g: int, gtype: GeometryType):
         # marker survives, which the caller writes as a null geometry
         raise ValueError("GEOMETRYCOLLECTION has no FlatGeobuf geometry")
     if t == GeometryType.POLYGON:
-        _rings_into(b, col, list(col.geom_parts(g)), fields)
+        _rings_into(b, col, list(col.geom_parts(g)), fields, with_z)
     else:
         xy = col.geom_xy(g)
         if t == GeometryType.MULTILINESTRING:
@@ -434,24 +436,33 @@ def _geometry_fields(b: _Builder, col, g: int, gtype: GeometryType):
             if len(ends) > 1:
                 fields[0] = ("offset", b.vector_scalar("I", ends))
         fields[1] = ("offset", b.vector_scalar("d", xy.reshape(-1).tolist()))
+        if with_z:
+            z = col.z[col.geom_vertex_slice(g)]
+            fields[2] = ("offset", b.vector_scalar("d", z.tolist()))
     fields[6] = ("scalar", "B", int(_WKB_OF[t]))
     return fields
 
 
-def _rings_into(b: _Builder, col, parts, fields) -> None:
-    """Closed-ring xy + ends vectors for one polygon's parts."""
-    chunks, ends, n = [], [], 0
+def _rings_into(b: _Builder, col, parts, fields, with_z: bool) -> None:
+    """Closed-ring xy (+z) and ends vectors for one polygon's parts."""
+    chunks, zchunks, ends, n = [], [], [], 0
     for p in parts:
         for r in col.part_rings(p):
-            xy = col.ring_xy(r)
-            closed = np.vstack([xy, xy[:1]]) if xy.shape[0] else xy
-            chunks.append(closed)
-            n += closed.shape[0]
+            xy, z = close_ring(
+                col.ring_xy(r), col.ring_z(r) if with_z else None
+            )
+            chunks.append(xy)
+            if with_z:
+                zchunks.append(z)
+            n += xy.shape[0]
             ends.append(n)
     xy_all = np.vstack(chunks) if chunks else np.zeros((0, 2))
     if len(ends) > 1:
         fields[0] = ("offset", b.vector_scalar("I", ends))
     fields[1] = ("offset", b.vector_scalar("d", xy_all.reshape(-1).tolist()))
+    if with_z:
+        z_all = np.concatenate(zchunks) if zchunks else np.zeros(0)
+        fields[2] = ("offset", b.vector_scalar("d", z_all.tolist()))
 
 
 _WKB_OF = {
@@ -492,12 +503,16 @@ def write_flatgeobuf(path: str, table: VectorTable, name: str = "layer",
         0: ("offset", hb.string(name)),
         2: ("scalar", "B", gtype),
         8: ("scalar", "Q", len(col)),
+    }
+    if any(col.has_z(g) for g in range(len(col))):
+        hfields[3] = ("scalar", "?", True)
+    hfields.update({
         9: ("scalar", "H", 0),  # no spatial index
         10: ("offset", hb.table({
             0: ("offset", hb.string("EPSG")),
             1: ("scalar", "i", int(srid)),
         })),
-    }
+    })
     if col_offs:
         hfields[7] = ("offset", hb.vector_offsets(col_offs))
     hdr = hb.finish(hb.table(hfields))

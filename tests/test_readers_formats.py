@@ -1130,3 +1130,32 @@ def test_flatgeobuf_null_geometry_and_trailing_bytes(tmp_path):
     open(p, "wb").write(whole[:-10])
     with pytest.raises(ValueError):
         read_flatgeobuf(p)
+
+
+def test_flatgeobuf_z_roundtrip(tmp_path):
+    """3D geometries keep their Z through write->read (header has_z flag
+    + slot-2 z vectors, closed in step with polygon rings)."""
+    from mosaic_tpu.core.geometry import wkt as W
+    from mosaic_tpu.readers.flatgeobuf import read_flatgeobuf, write_flatgeobuf
+    from mosaic_tpu.readers.vector import VectorTable
+
+    wkts = [
+        "POINT Z (1 2 7)",
+        "LINESTRING Z (0 0 1, 1 1 2, 2 0 3)",
+        "POLYGON Z ((0 0 5, 4 0 6, 4 4 7, 0 4 8, 0 0 5))",
+    ]
+    p = str(tmp_path / "z.fgb")
+    write_flatgeobuf(p, VectorTable(geometry=W.from_wkt(wkts), columns={}))
+    r = read_flatgeobuf(p)
+    g = r.geometry
+    assert all(g.has_z(i) for i in range(3))
+    np.testing.assert_allclose(g.ring_z(0), [7.0])
+    np.testing.assert_allclose(g.ring_z(1), [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(g.ring_z(2), [5.0, 6.0, 7.0, 8.0])
+    # 2D rows written alongside 3D stay 2D (per-geometry z emission)
+    p2 = str(tmp_path / "mix.fgb")
+    write_flatgeobuf(p2, VectorTable(
+        geometry=W.from_wkt(["POINT Z (1 2 7)", "POINT (3 4)"]), columns={}
+    ))
+    r2 = read_flatgeobuf(p2)
+    assert r2.geometry.has_z(0) and not r2.geometry.has_z(1)
