@@ -715,7 +715,11 @@ def main():
         # scale lane (TPU only): ≥16M points generated ON DEVICE (no
         # tunnel transfer), same compiled step — quantifies achieved HBM
         # bandwidth headroom toward the 1B-point north star
-        n_scale = int(os.environ.get("MOSAIC_BENCH_SCALE_POINTS", 16_000_000))
+        n_scale = (
+            0  # quick mode is self-contained: never run the slowest lane
+            if quick
+            else int(os.environ.get("MOSAIC_BENCH_SCALE_POINTS", 16_000_000))
+        )
         if (on_tpu or force_lanes) and n_scale >= n_device:
             try:
                 _prog(f"scale lane ({n_scale} pts, device-generated)")

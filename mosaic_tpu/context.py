@@ -107,6 +107,14 @@ class MosaicContext:
         ctx = cls(cfg, idx)
         with cls._lock:
             cls._instance = ctx
+        # the reference's enable_mosaic registers the kepler magic
+        # (`python/mosaic/api/enable.py:13-68`); best-effort here too
+        try:
+            from .viz import register_kepler_magic
+
+            register_kepler_magic()
+        except Exception:  # noqa: BLE001 — notebooks only, never fatal
+            pass
         return ctx
 
     @classmethod

@@ -501,6 +501,69 @@ def eqc_inverse(p, en, xp=np, iters: int = 6):
     return xp.stack([lon, lat], axis=-1)
 
 
+def sinu_forward(p, lonlat, xp=np):
+    """Sinusoidal (Snyder 30, ellipsoidal) — the MODIS tile grid's
+    projection. Equal-area; central meridian true to scale."""
+    a, e, lon0, fe, fn = p
+    e2 = e * e
+    arc = _poly_arc_params(a, e)
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    s = xp.sin(lat)
+    x = fe + a * (lon - lon0) * xp.cos(lat) / xp.sqrt(1 - e2 * s * s)
+    y = fn + _tm_meridional_arc(arc, lat, xp)
+    return xp.stack([x, y], axis=-1)
+
+
+def sinu_inverse(p, en, xp=np, iters: int = 6):
+    a, e, lon0, fe, fn = p
+    e2 = e * e
+    arc = _poly_arc_params(a, e)
+    m = en[..., 1] - fn
+    lat = m / a
+    for _ in range(iters):  # fixed-count footpoint, as tm_inverse
+        lat = lat + (m - _tm_meridional_arc(arc, lat, xp)) / a
+    s = xp.sin(lat)
+    c = xp.maximum(xp.cos(lat), 1e-12)
+    lon = lon0 + (en[..., 0] - fe) * xp.sqrt(1 - e2 * s * s) / (a * c)
+    return xp.stack([lon, lat], axis=-1)
+
+
+def moll_forward(p, lonlat, xp=np, iters: int = 8):
+    """Mollweide (Snyder 31; spherical, matching PROJ's +proj=moll which
+    treats the semi-major axis as the sphere radius)."""
+    a, lon0, fe, fn = p
+    lon, lat = lonlat[..., 0], lonlat[..., 1]
+    # fixed-count Newton for 2*th + sin(2*th) = pi*sin(lat). The
+    # derivative vanishes at the poles where Newton from th=lat crawls
+    # (1e-5 residual after 8 rounds at 89 deg); seeding with the
+    # cube-root asymptote th ~ pi/2 - (0.75 d)^(1/3), d = pi - |rhs|,
+    # converges to machine epsilon in <=6 rounds at EVERY latitude
+    rhs = np.pi * xp.sin(lat)
+    d = np.pi - xp.abs(rhs)
+    th = xp.sign(lat) * (np.pi / 2 - (0.75 * d) ** (1.0 / 3.0))
+    for _ in range(iters):
+        th = th - (2 * th + xp.sin(2 * th) - rhs) / xp.maximum(
+            2 + 2 * xp.cos(2 * th), 1e-9
+        )
+    th = xp.where(
+        xp.abs(lat) >= np.pi / 2 - 1e-9, xp.sign(lat) * (np.pi / 2), th
+    )
+    x = fe + a * (2.0 * math.sqrt(2.0) / np.pi) * (lon - lon0) * xp.cos(th)
+    y = fn + a * math.sqrt(2.0) * xp.sin(th)
+    return xp.stack([x, y], axis=-1)
+
+
+def moll_inverse(p, en, xp=np):
+    a, lon0, fe, fn = p
+    th = xp.arcsin(xp.clip((en[..., 1] - fn) / (a * math.sqrt(2.0)), -1, 1))
+    lat = xp.arcsin(xp.clip((2 * th + xp.sin(2 * th)) / np.pi, -1, 1))
+    c = xp.maximum(xp.cos(th), 1e-12)
+    lon = lon0 + (en[..., 0] - fe) * np.pi / (
+        2.0 * math.sqrt(2.0) * a * c
+    )
+    return xp.stack([lon, lat], axis=-1)
+
+
 def _sterea_consts(p):
     """Oblique-stereographic constants (EPSG Guidance Note 7-2, 'Oblique
     Stereographic' — the double projection onto the conformal sphere)."""

@@ -37,6 +37,10 @@ from .crs import (
     cea_inverse,
     eqc_forward,
     eqc_inverse,
+    moll_forward,
+    moll_inverse,
+    sinu_forward,
+    sinu_inverse,
     eqdc_forward,
     eqdc_inverse,
     laea_forward,
@@ -111,7 +115,7 @@ UNITS: dict[str, float] = {
 _SUPPORTED_PROJ = (
     "utm, tmerc (incl. +axis=wsu south-orientated), merc, lcc, aea, eqdc, "
     "laea, stere (polar), sterea, somerc, omerc (Hotine A/B), krovak, "
-    "cass, poly, nzmg, cea, eqc, longlat/latlong"
+    "cass, poly, nzmg, cea, eqc, sinu, moll, longlat/latlong"
 )
 
 
@@ -342,6 +346,14 @@ def parse_proj(s: str, area: tuple | None = None) -> ProjCRS:
         lat_ts = _R(_f(kv, "lat_ts", 0.0))
         p = (a, e, lat_ts, lat0, lon0, fe, fn)
         return ProjCRS("eqc", p, a, e2, shift, to_meter, area)
+    if proj == "sinu":
+        p = (a, e, lon0, fe, fn)
+        return ProjCRS("sinu", p, a, e2, shift, to_meter, area)
+    if proj == "moll":
+        # spherical formulation on radius a (PROJ behavior); validity
+        # bounds still use the declared ellipsoid for the datum shift
+        p = (a, lon0, fe, fn)
+        return ProjCRS("moll", p, a, e2, shift, to_meter, area)
     if proj == "poly":
         p = (a, e, lat0, lon0, fe, fn)
         return ProjCRS("poly", p, a, e2, shift, to_meter, area)
@@ -390,6 +402,8 @@ _FWD = {
     "cass": cass_forward,
     "cea": cea_forward,
     "eqc": eqc_forward,
+    "sinu": sinu_forward,
+    "moll": moll_forward,
     "eqdc": eqdc_forward,
     "omerc": omerc_forward,
     "tm_south": tm_south_forward,
@@ -409,6 +423,8 @@ _INV = {
     "cass": cass_inverse,
     "cea": cea_inverse,
     "eqc": eqc_inverse,
+    "sinu": sinu_inverse,
+    "moll": moll_inverse,
     "eqdc": eqdc_inverse,
     "omerc": omerc_inverse,
     "tm_south": tm_south_inverse,
@@ -524,7 +540,7 @@ def default_area(crs: ProjCRS) -> tuple[float, float, float, float]:
             if south
             else (-180.0, 60.0, 180.0, 90.0)
         )
-    if crs.kind in ("cea", "eqc"):  # world cylindrical grids
+    if crs.kind in ("cea", "eqc", "sinu", "moll"):  # world grids
         return (-180.0, -86.0, 180.0, 86.0)
     raise ValueError(f"no default area for projection kind {crs.kind!r}")
 
@@ -931,6 +947,22 @@ _EPSG[2100] = (
     "+proj=tmerc +lat_0=0 +lon_0=24 +k=0.9996 +x_0=500000 +y_0=0 "
     "+datum=GGRS87",
     (19.57, 34.88, 28.30, 41.75),
+)
+
+# world equal-area singles (ESRI codes, the ints the ecosystem uses):
+# 54008 Sinusoidal, 54009 Mollweide, both on WGS84; and the MODIS
+# sinusoidal sphere grid under its common SR-ORG id 6974
+_EPSG[54008] = (
+    "+proj=sinu +lon_0=0 +x_0=0 +y_0=0 +ellps=WGS84",
+    (-180.0, -90.0, 180.0, 90.0),
+)
+_EPSG[54009] = (
+    "+proj=moll +lon_0=0 +x_0=0 +y_0=0 +ellps=WGS84",
+    (-180.0, -90.0, 180.0, 90.0),
+)
+_EPSG[6974] = (
+    "+proj=sinu +lon_0=0 +x_0=0 +y_0=0 +a=6371007.181 +b=6371007.181",
+    (-180.0, -90.0, 180.0, 90.0),
 )
 
 # the Ferro-referenced original S-JTSK code shares 5514's definition

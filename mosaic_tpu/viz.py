@@ -16,7 +16,13 @@ from pathlib import Path
 
 import numpy as np
 
-__all__ = ["to_feature_collection", "plot_cells", "plot_geometries", "mosaic_kepler"]
+__all__ = [
+    "to_feature_collection",
+    "plot_cells",
+    "plot_geometries",
+    "mosaic_kepler",
+    "register_kepler_magic",
+]
 
 
 def to_feature_collection(geom, properties: "dict | None" = None) -> dict:
@@ -71,6 +77,71 @@ def mosaic_kepler(geom_or_cells, kind: str = "geometry", **kw):
     if kind in ("h3", "bng", "cell", "cells"):
         return plot_cells(geom_or_cells, **kw)
     return plot_geometries(geom_or_cells, **kw)
+
+
+def _magic_render(user_ns: dict, line: str, cell: str = ""):
+    """Shared implementation of the ``%%mosaic_kepler`` cell magic.
+
+    Grammar mirrors the reference magic's
+    ``<dataset> <column> <h3|bng|geometry> [<limit>]``
+    (`python/mosaic/utils/kepler_magic.py:18-70`): ``dataset`` names a
+    variable in the notebook namespace (a reader ``VectorTable``, a dict
+    of columns, or the column itself), ``column`` picks the cell-id or
+    geometry column, ``h3``/``bng`` render cell boundaries while
+    ``geometry`` renders the geometries directly."""
+    args = (line.strip() + " " + (cell or "").strip()).split()
+    if len(args) < 3:
+        raise ValueError(
+            "usage: %%mosaic_kepler <dataset> <column> <h3|bng|geometry>"
+            " [<limit>]"
+        )
+    name, colname, kind = args[0], args[1], args[2].lower()
+    if kind in ("cell", "cells"):
+        kind = "h3"
+    if kind not in ("h3", "bng", "geometry"):
+        raise ValueError(
+            f"feature type must be h3, bng or geometry, got {args[2]!r}"
+        )
+    limit = int(args[3]) if len(args) > 3 else None
+    if name not in user_ns:
+        raise ValueError(f"no variable {name!r} in the notebook namespace")
+    obj = user_ns[name]
+    if hasattr(obj, "columns") and hasattr(obj, "geometry"):  # VectorTable
+        col = obj.geometry if colname == "geometry" else obj.columns[colname]
+    elif isinstance(obj, dict):
+        col = obj[colname]
+    else:
+        col = obj  # the dataset IS the column
+    if limit is not None:
+        col = col.take(list(range(min(limit, len(col))))) if hasattr(
+            col, "take"
+        ) and hasattr(col, "geometry_type") else col[:limit]
+    if kind in ("h3", "bng"):
+        from .context import index_system_factory
+
+        return plot_cells(col, index=index_system_factory(kind.upper()))
+    return plot_geometries(col)
+
+
+def register_kepler_magic(ipython=None):
+    """Register ``%%mosaic_kepler`` with IPython (the reference wires this
+    in ``enable_mosaic`` — `python/mosaic/api/enable.py:13-68`). Returns
+    the magic function, or None outside IPython."""
+    try:
+        from IPython.core.getipython import get_ipython
+    except ImportError:  # plain-python process: the magic has no host
+        return None
+    ip = ipython or get_ipython()
+    if ip is None:
+        return None
+
+    def magic(line, cell=""):
+        return _magic_render(ip.user_ns, line, cell)
+
+    magic.__name__ = "mosaic_kepler"
+    ip.register_magic_function(magic, magic_kind="cell",
+                               magic_name="mosaic_kepler")
+    return magic
 
 
 _KEPLER_CONFIG = {

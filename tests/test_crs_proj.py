@@ -48,7 +48,7 @@ _ROUNDTRIP_CODES = sorted(_CSV_ROWS) + [
     # UTM, Japan zones (all three datum generations), Irish grids, Greek
     4087, 4088, 6933, 3410, 28407, 28422, 28432, 32230, 32330, 26710,
     23031, 20255, 20355, 29171, 29193, 30169, 2451, 6677, 29902, 2157,
-    2100,
+    2100, 54008, 54009, 6974,
 ]
 
 
@@ -583,3 +583,31 @@ def test_pulkovo_gk_false_easting_prefix():
         lon0 = zone * 6 - 3 - (360 if zone * 6 - 3 > 180 else 0)
         en = crs.from_wgs84(np.array([[lon0, 55.0]]), srid)
         assert abs(en[0, 0] - (zone * 1e6 + 500000)) < 300  # datum shift
+
+
+def test_sinusoidal_modis_grid_anchor():
+    """The MODIS sinusoidal sphere grid (SR-ORG 6974): the published tile
+    grid half-width is 20015109.354 m (R * pi); the equal-area property
+    compresses x with cos(lat)."""
+    en = crs.from_wgs84(np.array([[180.0, 0.0]]), 6974)
+    assert abs(en[0, 0] - 20015109.354) < 2.0
+    x60 = crs.from_wgs84(np.array([[10.0, 60.0]]), 6974)[0, 0]
+    x00 = crs.from_wgs84(np.array([[10.0, 0.0]]), 6974)[0, 0]
+    assert abs(x60 / x00 - 0.5) < 1e-9  # cos(60) exactly on the sphere
+
+
+def test_mollweide_constants_and_poles():
+    """Mollweide: x(90E, 0) = sqrt(2) R, the poles map to y = +-sqrt(2) R
+    without NaN (the Newton seed handles the vanishing derivative), and
+    near-pole round-trips stay tight."""
+    R = 6378137.0
+    en = crs.from_wgs84(
+        np.array([[90.0, 0.0], [0.0, 90.0], [0.0, -90.0]]), 54009
+    )
+    assert abs(en[0, 0] - np.sqrt(2) * R) < 1e-6
+    assert abs(en[1, 1] - np.sqrt(2) * R) < 1e-6
+    assert abs(en[2, 1] + np.sqrt(2) * R) < 1e-6
+    assert np.isfinite(en).all()
+    ll = np.array([[12.3, 89.2], [-45.0, -88.5], [179.0, -89.99]])
+    rt = crs.to_wgs84(crs.from_wgs84(ll, 54009), 54009)
+    assert np.abs(rt - ll).max() < 1e-7

@@ -46,3 +46,65 @@ def test_timer_and_benchmark(caplog):
     stats = benchmark(lambda x: jnp.sum(x * 2), jnp.arange(1000.0), trials=3)
     assert stats["min_s"] <= stats["median_s"]
     assert get_logger().name == "mosaic_tpu"
+
+
+def test_kepler_cell_magic(tmp_path, monkeypatch):
+    """The registered %%mosaic_kepler magic resolves notebook variables
+    and renders through the same plot paths (reference:
+    `python/mosaic/utils/kepler_magic.py:18-70`)."""
+    import os
+
+    from mosaic_tpu.readers.vector import VectorTable
+    from mosaic_tpu.core.geometry import wkt as W
+
+    monkeypatch.chdir(tmp_path)
+    table = VectorTable(
+        geometry=W.from_wkt(
+            ["POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))", "POINT (2 2)"]
+        ),
+        columns={
+            "cell": np.asarray(
+                F.grid_longlatascellid(
+                    np.array([-0.1, -0.2]), np.array([51.5, 51.6]), 7,
+                    index=H3IndexSystem(),
+                )
+            )
+        },
+    )
+    ns = {"t": table}
+    out = viz._magic_render(ns, "t geometry geometry")
+    assert str(out).endswith(".html") and os.path.exists(out)
+    out = viz._magic_render(ns, "t cell h3 1")
+    assert str(out).endswith(".html")
+    # grammar + namespace errors are loud
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="usage"):
+        viz._magic_render(ns, "t geometry")
+    with _pytest.raises(ValueError, match="no variable"):
+        viz._magic_render(ns, "missing geometry geometry")
+    with _pytest.raises(ValueError, match="feature type"):
+        viz._magic_render(ns, "t cell hexes")
+    # case-insensitive kind; cell/cells aliases accepted like mosaic_kepler
+    assert str(viz._magic_render(ns, "t cell CELLS 1")).endswith(".html")
+
+
+def test_kepler_magic_registration(tmp_path, monkeypatch):
+    """register_kepler_magic wires the cell magic into a live IPython
+    shell; MosaicContext.build auto-registers it (enable_mosaic parity)."""
+    pytest_ipython = __import__("pytest").importorskip("IPython")
+    from IPython.core.interactiveshell import InteractiveShell
+
+    monkeypatch.chdir(tmp_path)
+    shell = InteractiveShell.instance()
+    try:
+        from mosaic_tpu import viz as _viz
+
+        fn = _viz.register_kepler_magic(shell)
+        assert fn is not None
+        shell.user_ns["col"] = ["POINT (1 1)"]
+        # args may continue into the cell body (IPython rejects an empty one)
+        out = shell.run_cell_magic("mosaic_kepler", "col x", "geometry")
+        assert str(out).endswith(".html")
+    finally:
+        InteractiveShell.clear_instance()
