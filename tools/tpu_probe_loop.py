@@ -93,6 +93,29 @@ def _live_ok() -> bool:
         return False
 
 
+def _promotes(line: dict, quick: bool) -> bool:
+    """Complete full-bench artifacts outrank quick or salvaged ones;
+    within the same grade, a higher headline wins. bench.py's salvage
+    path (late-lane failure) exits 0 with value>0 but detail.error set —
+    such a line must never replace a complete LIVE artifact."""
+    try:
+        with open(LIVE) as f:
+            cur = json.load(f)
+    except (OSError, ValueError):
+        return True
+
+    def grade(obj: dict, is_quick: bool) -> int:
+        det = obj.get("detail", {})
+        return 2 if not (is_quick or det.get("quick") or det.get("error")) \
+            else 1
+
+    g_new = grade(line, quick)
+    g_cur = grade(cur, False)
+    if g_new != g_cur:
+        return g_new > g_cur
+    return float(line.get("value", 0)) >= float(cur.get("value", 0))
+
+
 def run_bench(quick: bool = False) -> bool:
     """Bench pinned to TPU; True if a line with value>0 was captured.
 
@@ -158,9 +181,9 @@ def run_bench(quick: bool = False) -> bool:
     ) as f:
         json.dump(line, f, indent=1)
     ok = bool(line.get("value", 0))
-    if ok and (not quick or not _live_ok()):
-        # LIVE holds the best evidence so far: a quick number never
-        # overwrites an existing full-bench artifact
+    if ok and _promotes(line, quick):
+        # LIVE holds the best evidence so far: a quick or salvaged
+        # (detail.error set) number never replaces a complete full run
         with open(LIVE, "w") as f:
             json.dump(line, f, indent=1)
     log({"outcome": ("bench_quick_ok" if quick else "bench_ok") if ok
