@@ -177,7 +177,6 @@ def _geo_to_cell_device(
     from jax import lax
 
     t = derive()
-    pent_cw = xp.asarray(t.pent_cw_faces)  # only the (rare) pentagon branch
     face, x, y = hm.geo_to_hex2d(lat, lng, res, xp=xp)
     margin = _rel_margin(x, y, res, xp) if with_margin else None
     i, j, k = _alt_ijk(x, y, xp) if alt else hm.hex2d_to_ijk(x, y, xp)
@@ -253,9 +252,25 @@ def _geo_to_cell_device(
         return (cells, margin) if with_margin else cells
 
     def _pent_fix(args):
+        # Gather-free on purpose: this branch fires for the WHOLE batch
+        # the moment it contains ONE pentagon point, and data-dependent
+        # gathers serialize on TPU (measured: the old `table[digits]` /
+        # `pent_cw[bc]` formulation cost ~610 ms at 4M points — 25x the
+        # entire join probe — for any batch touching a pentagon face,
+        # e.g. a global point cloud). Select-chains keep it fused VPU
+        # work; cells stay bit-identical (parity + pentagon fuzz tests).
         digits, digits_hex = args
         lead = _lead_digit(digits, xp)
-        cw_off = (pent_cw[bc, 0] == face) | (pent_cw[bc, 1] == face)
+        # cw_off only matters where `pent` holds, so a 12-row select
+        # over the pentagon base cells replaces the (N,) table gather
+        pent_cw_np = np.asarray(t.pent_cw_faces)
+        pent_bcs = np.where(np.asarray(t.is_pentagon))[0]
+        cw_off = xp.zeros(face.shape, dtype=bool)
+        for p in pent_bcs:
+            hit = (face == int(pent_cw_np[p, 0])) | (
+                face == int(pent_cw_np[p, 1])
+            )
+            cw_off = xp.where(bc == int(p), hit, cw_off)
         need = pent & (lead == C.K_AXES_DIGIT)
         adj = xp.where(
             cw_off[..., None],
@@ -278,15 +293,26 @@ def _geo_to_cell_device(
 
 
 def _rot_tab(digits, table, xp):
-    return xp.asarray(table, dtype=xp.int32)[digits]
+    """``table[digits]`` as a select-chain (digit values are 0..6):
+    a data-dependent gather would serialize on TPU (see _pent_fix)."""
+    tab = np.asarray(table, dtype=np.int32)
+    out = xp.zeros_like(digits)
+    for v in range(tab.shape[0]):
+        out = xp.where(digits == v, xp.asarray(np.int32(tab[v])), out)
+    return out
 
 
 def _lead_digit(digits, xp):
-    """First non-zero digit along the last axis of (N, res) digits."""
-    nz = digits != 0
-    idx = xp.argmax(nz, axis=-1)
-    d = xp.take_along_axis(digits, idx[..., None], axis=-1)[..., 0]
-    return xp.where(nz.any(axis=-1), d, xp.zeros_like(d))
+    """First non-zero digit along the last axis of (N, res) digits.
+
+    Left-to-right select scan — gather-free (take_along_axis serializes
+    on TPU, see _pent_fix); res <= 15 so the unroll is small.
+    """
+    lead = xp.zeros(digits.shape[:-1], dtype=digits.dtype)
+    for r in range(digits.shape[-1]):
+        d = digits[..., r]
+        lead = xp.where(lead != 0, lead, d)
+    return lead
 
 
 def _rotate_pent60_ccw_i32(digits, xp):
