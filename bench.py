@@ -450,10 +450,11 @@ def main():
             return c.astype(jnp.int64)
 
         @functools.partial(
-            jax.jit, static_argnames=("found_cap", "heavy_cap", "writeback")
+            jax.jit,
+            static_argnames=("found_cap", "heavy_cap", "writeback", "lookup"),
         )
         def step(points_f64, chip_index, found_cap, heavy_cap,
-                 writeback="scatter"):
+                 writeback="scatter", lookup="gather"):
             cells = h3.point_to_cell(points_f64.astype(cell_dtype), RES)
             shifted = (points_f64 - chip_index.border.shift).astype(dtype)
             return pip_join_points(
@@ -463,6 +464,7 @@ def main():
                 heavy_cap=heavy_cap,
                 found_cap=found_cap,
                 writeback=writeback,
+                lookup=lookup,
             )
 
         # full-bit XOR-shift fold: every result bit stays live (a masked
@@ -561,11 +563,13 @@ def main():
         rtt = min(rtts)
         detail["sync_rtt_s"] = round(rtt, 4)
 
-        def run_pass(sp, fc, hc, wb="scatter"):
+        def run_pass(sp, fc, hc, wb="scatter", lk="gather"):
             """Time one pass: dispatch every batch, force completion via
             the device fold of each output pulled as one chained scalar."""
             t0 = time.perf_counter()
-            outs = [step(sb, index, fc, hc, writeback=wb) for sb in sp]
+            outs = [
+                step(sb, index, fc, hc, writeback=wb, lookup=lk) for sb in sp
+            ]
             tot = None
             for o in outs:
                 s = _fold(o)
@@ -604,45 +608,39 @@ def main():
         detail["writeback"] = {"scatter": round(dev_rate, 1)}
         detail["main_points_per_sec"] = round(dev_rate, 1)
 
-        # TPU autotune: A/B the gather writeback (r3 traces put the final
-        # 4M scatter at ~30 ms) and headline the winner
+        # TPU autotune: A/B the probe plumbing variants and headline the
+        # winner. (writeback, lookup) pairs — "mxu" replaces the tier-1
+        # row gather with a bit-exact one-hot MXU matmul (measured
+        # 2026-07-31 on v5e: scatter+mxu 63.4M vs scatter+gather 34.9M
+        # pts/s). Each variant has its own try: one failure (the direct
+        # lane has hit tpu_compile_helper crashes) must not lose the rest.
+        win_wb, win_lk = "scatter", "gather"
         if on_tpu or force_lanes:
-            try:
-                _prog("gather writeback lane")
-                run_pass(staged_passes[0], fcap, hcap, wb="gather")  # compile
-                g_times = [
-                    round(run_pass(sp, fcap, hcap, wb="gather")[0], 4)
-                    for sp in staged_passes
-                ]
-                g_s = max(min(g_times) - rtt, 1e-9)
-                detail["writeback"]["gather"] = round(n_device / g_s, 1)
-                detail["writeback"]["gather_passes_s"] = g_times
-                if g_s < dev_s:
-                    dev_s, dev_rate = g_s, n_device / g_s
-                    detail["writeback"]["winner"] = "gather"
-                else:
-                    detail["writeback"]["winner"] = "scatter"
-            except Exception as e:
-                detail["writeback"]["gather_error"] = repr(e)[:200]
-            # third variant: no tier-1 compaction at all (every point
-            # gathers its own edge row; wins when prefix+scatter+
-            # writeback cost more than the wasted miss gathers). Own try:
-            # a direct failure must not lose the scatter/gather verdict.
-            try:
-                _prog("direct writeback lane")
-                run_pass(staged_passes[0], fcap, hcap, wb="direct")
-                d_times = [
-                    round(run_pass(sp, fcap, hcap, wb="direct")[0], 4)
-                    for sp in staged_passes
-                ]
-                d_s = max(min(d_times) - rtt, 1e-9)
-                detail["writeback"]["direct"] = round(n_device / d_s, 1)
-                detail["writeback"]["direct_passes_s"] = d_times
-                if d_s < dev_s:
-                    dev_s, dev_rate = d_s, n_device / d_s
-                    detail["writeback"]["winner"] = "direct"
-            except Exception as e:
-                detail["writeback"]["direct_error"] = repr(e)[:200]
+            variants = [
+                ("scatter", "mxu"),
+                ("gather", "gather"),
+                ("gather", "mxu"),
+                ("direct", "gather"),
+            ]
+            detail["writeback"]["winner"] = "scatter"
+            for wb, lk in variants:
+                name = wb if lk == "gather" else f"{wb}+{lk}"
+                try:
+                    _prog(f"{name} variant lane")
+                    run_pass(staged_passes[0], fcap, hcap, wb=wb, lk=lk)
+                    v_times = [
+                        round(run_pass(sp, fcap, hcap, wb=wb, lk=lk)[0], 4)
+                        for sp in staged_passes
+                    ]
+                    v_s = max(min(v_times) - rtt, 1e-9)
+                    detail["writeback"][name] = round(n_device / v_s, 1)
+                    detail["writeback"][f"{name}_passes_s"] = v_times
+                    if v_s < dev_s:
+                        dev_s, dev_rate = v_s, n_device / v_s
+                        detail["writeback"]["winner"] = name
+                        win_wb, win_lk = wb, lk
+                except Exception as e:
+                    detail["writeback"][f"{name}_error"] = repr(e)[:200]
             detail["main_points_per_sec"] = round(dev_rate, 1)
         # probe traffic: found points pay the tier-1 flat edge gather
         # (20 B/edge), heavy-cell points additionally the tier-2 row — the
@@ -750,7 +748,11 @@ def main():
                 souts0: list = []
                 for p, sp in enumerate(scale_passes):
                     t0 = time.perf_counter()
-                    outs = [step(sb, index, fcap, hcap) for sb in sp]
+                    outs = [
+                        step(sb, index, fcap, hcap,
+                             writeback=win_wb, lookup=win_lk)
+                        for sb in sp
+                    ]
                     tot = None
                     for o in outs:
                         s = _fold(o)

@@ -702,6 +702,77 @@ def _compact(flag: jax.Array, cap: int):
     return src, valid, flag & (pos >= cap), pos
 
 
+def _mm_rows(idx: jax.Array, table_f32: jax.Array) -> jax.Array:
+    """``table_f32[idx]`` as a one-hot MXU matmul — bit-exact f32 row
+    gather.
+
+    Data-dependent row gathers serialize on TPU (~10 GB/s effective on
+    the 512 B tier-1 edge rows, ~42 ms at a 640k-point cap); contracting
+    a (K, U) one-hot against the (U, D) row table runs on the MXU
+    instead. Exactness: each one-hot row has a single 1, and any f32
+    value splits exactly into three bf16 terms (Sterbenz: the rounded
+    high part is within a factor 2 of the remainder, so each residual
+    subtraction is exact); each output element is therefore reassembled
+    from <= 3 exact partial products in a f32 accumulator — a bit-exact
+    gather, asserted against the real gather in tests.
+
+    idx: (K,) int32 in [0, U); table_f32: (U, D) f32 -> (K, D) f32.
+    """
+    U = table_f32.shape[0]
+    oh = (
+        idx[:, None] == jnp.arange(U, dtype=idx.dtype)[None, :]
+    ).astype(jnp.bfloat16)
+    hi = table_f32.astype(jnp.bfloat16)
+    r = table_f32 - hi.astype(jnp.float32)
+    mid = r.astype(jnp.bfloat16)
+    lo = (r - mid.astype(jnp.float32)).astype(jnp.bfloat16)
+    dot = functools.partial(
+        jax.lax.dot_general,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return dot(oh, hi) + dot(oh, mid) + dot(oh, lo)
+
+
+def _tier1_rows_mxu(us: jax.Array, index: "ChipIndex"):
+    """All tier-1 per-cell rows for slots ``us`` in ONE MXU lookup.
+
+    Packs cell_edges / cell_ebits (split into exact 16-bit halves) /
+    cell_slot_geom / cell_slot_core / cell_heavy into a single (U, D)
+    f32 matrix so the one-hot operand is built and contracted once.
+    Integer fields survive exactly: every value (parity-bit halves
+    <= 65535, geom/heavy ids < 2^24, bools) is an integer exactly
+    representable in f32. Returns (edges (K, E1, 4), ebits (K, E1) u32,
+    geoms (K, M1) i32, cores (K, M1) bool, heavy (K,) i32).
+    """
+    U, E1 = index.cell_ebits.shape
+    M1 = index.cell_slot_geom.shape[1]
+    eb = index.cell_ebits
+    tab = jnp.concatenate(
+        [
+            index.cell_edges.reshape(U, E1 * 4).astype(jnp.float32),
+            (eb >> jnp.uint32(16)).astype(jnp.float32),
+            (eb & jnp.uint32(0xFFFF)).astype(jnp.float32),
+            index.cell_slot_geom.astype(jnp.float32),
+            index.cell_slot_core.astype(jnp.float32),
+            index.cell_heavy.astype(jnp.float32)[:, None],
+        ],
+        axis=1,
+    )
+    out = _mm_rows(us, tab)
+    o = E1 * 4
+    edges = out[:, :o].reshape(-1, E1, 4)
+    hi16, lo16 = out[:, o : o + E1], out[:, o + E1 : o + 2 * E1]
+    o += 2 * E1
+    ebits = (hi16.astype(jnp.uint32) << jnp.uint32(16)) | lo16.astype(
+        jnp.uint32
+    )
+    geoms = out[:, o : o + M1].astype(jnp.int32)
+    cores = out[:, o + M1 : o + 2 * M1] > 0.5
+    heavy = out[:, o + 2 * M1].astype(jnp.int32)
+    return edges, ebits, geoms, cores, heavy
+
+
 def _heavy_tier(px, py, hs, index, heavy_cap, k2_default, out_len, eps2):
     """Tier 2, shared by every probe plumbing mode: compact the rows whose
     cell is heavy, probe the wide rows, scatter back to ``out_len``.
@@ -737,6 +808,7 @@ def pip_join_points(
     found_cap: int | None = None,
     edge_eps2: jax.Array | None = None,
     writeback: str = "scatter",
+    lookup: str = "gather",
 ) -> jax.Array:
     """(N,) int32 — smallest matching polygon row per point, -1 if none.
 
@@ -773,6 +845,14 @@ def pip_join_points(
         raise ValueError(
             f"writeback must be scatter|gather|direct, got {writeback!r}"
         )
+    if lookup not in ("gather", "mxu"):
+        raise ValueError(f"lookup must be gather|mxu, got {lookup!r}")
+    if lookup == "mxu" and (
+        writeback == "direct" or index.cell_edges.dtype != jnp.float32
+    ):
+        # direct mode probes ALL N points (a (N, U) one-hot would not
+        # fit), and the 3-term bf16 split is exact only for f32 tables
+        lookup = "gather"
     N = points.shape[0]
     u = _probe_slot(pcells, index)
     found = u >= 0
@@ -814,19 +894,20 @@ def pip_join_points(
     px, py = points[src1, 0], points[src1, 1]
 
     banded = edge_eps2 is not None
-    r1 = _ray_parity(
-        px, py, index.cell_edges[us], index.cell_ebits[us],
-        eps2=edge_eps2,
-    )
+    if lookup == "mxu":
+        edges1, ebits1, geoms1, cores1, heavy1 = _tier1_rows_mxu(us, index)
+    else:
+        edges1, ebits1 = index.cell_edges[us], index.cell_ebits[us]
+        geoms1, cores1 = index.cell_slot_geom[us], index.cell_slot_core[us]
+        heavy1 = index.cell_heavy[us]
+    r1 = _ray_parity(px, py, edges1, ebits1, eps2=edge_eps2)
     parity, near1 = r1 if banded else (r1, None)
-    best1 = _slot_best(
-        parity, index.cell_slot_geom[us], index.cell_slot_core[us]
-    )
+    best1 = _slot_best(parity, geoms1, cores1)
     best1 = jnp.where(valid1, best1, _SENTINEL)
 
     if H:
         # tier 2: compact again to the points whose cell is heavy
-        hs = jnp.where(valid1, index.cell_heavy[us], -1)
+        hs = jnp.where(valid1, heavy1, -1)
         best2, over2, near_sc = _heavy_tier(
             px, py, hs, index, heavy_cap, K1, K1, edge_eps2
         )
@@ -861,7 +942,8 @@ def pip_join_points(
 
 # module-level jit so repeated pip_join calls share the compilation cache
 _JIT_JOIN = jax.jit(
-    pip_join_points, static_argnames=("heavy_cap", "found_cap", "writeback")
+    pip_join_points,
+    static_argnames=("heavy_cap", "found_cap", "writeback", "lookup"),
 )
 
 
@@ -915,6 +997,7 @@ def pip_join(
     recheck: bool | None = None,
     cell_dtype=None,
     writeback: str = "scatter",
+    lookup: str | None = None,
 ) -> np.ndarray:
     """Managed join (reference: `PointInPolygonJoin.join` auto-indexes both
     sides, `sql/join/PointInPolygonJoin.scala:86-97`).
@@ -944,7 +1027,9 @@ def pip_join(
 
     ``writeback`` selects the probe plumbing (``scatter``/``gather``/
     ``direct`` — see :func:`pip_join_points`); results are identical,
-    the bench autotunes the winner per workload.
+    the bench autotunes the winner per workload. ``lookup`` picks the
+    tier-1 row access (``gather``/``mxu`` one-hot matmul); default None
+    auto-selects ``mxu`` on accelerators for f32 indexes.
     """
     resolution = index_system.resolution_arg(resolution)
     if chip_index is None:
@@ -969,6 +1054,12 @@ def pip_join(
         else np.asarray(chip_index.border.shift, dtype=np.float64)
     )
     dtype = chip_index.border.verts.dtype
+    if lookup is None:
+        lookup = (
+            "mxu"
+            if jax.devices()[0].platform != "cpu" and dtype == jnp.float32
+            else "gather"
+        )
     n = raw.shape[0]
 
     def run(chunk: np.ndarray) -> np.ndarray:
@@ -1015,6 +1106,7 @@ def pip_join(
                 _JIT_JOIN(
                     shifted, cells, chip_index,
                     heavy_cap=hcap, found_cap=fcap, writeback=writeback,
+                    lookup=lookup,
                 )
             )
 
@@ -1027,7 +1119,7 @@ def pip_join(
         out_dev, near = _JIT_JOIN(
             shifted, cells, chip_index,
             heavy_cap=hcap, found_cap=fcap, edge_eps2=eps2,
-            writeback=writeback,
+            writeback=writeback, lookup=lookup,
         )
         out = np.array(out_dev)  # writable host copies
         host_mask = np.array(near)  # PIP-boundary band -> host

@@ -133,3 +133,48 @@ def test_writeback_variants_identical():
     a2 = np.asarray(a2)
     ok = a2 != -2
     np.testing.assert_array_equal(a2[ok], d2[ok])
+
+
+def test_mxu_lookup_bit_exact():
+    """The one-hot MXU row lookup is an autotuning knob: `_mm_rows` must
+    be a bit-exact f32 gather (3-term bf16 split, single one-hot hit per
+    row), and the full join must be bitwise identical to the gather
+    lookup, bands included."""
+    import jax
+    import jax.numpy as jnp
+
+    from mosaic_tpu.core.index import H3
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.sql.join import _mm_rows, build_chip_index, pip_join_points
+
+    rng = np.random.default_rng(5)
+    # exponents spanning the f32 range stress the bf16 split exactness
+    tab = jnp.asarray(
+        (rng.standard_normal((90, 50))
+         * (10.0 ** rng.integers(-20, 20, (90, 50)))).astype(np.float32)
+    )
+    idx = jnp.asarray(rng.integers(0, 90, 2048).astype(np.int32))
+    got = np.asarray(jax.jit(_mm_rows)(idx, tab))
+    np.testing.assert_array_equal(got, np.asarray(tab)[np.asarray(idx)])
+
+    col = wkt.from_wkt(ZONES)
+    cidx = build_chip_index(tessellate(col, H3, 3, keep_core_geoms=False))
+    pts = np.column_stack(
+        [rng.uniform(-25, 35, 20000), rng.uniform(-25, 20, 20000)]
+    )
+    cells = H3.point_to_cell(jnp.asarray(pts, jnp.float32), 3)
+    shifted = jnp.asarray(
+        pts - np.asarray(cidx.border.shift, np.float64),
+        dtype=cidx.border.verts.dtype,
+    )
+    eps2 = jnp.asarray(1e-10, cidx.border.verts.dtype)
+    for wb in ("scatter", "gather"):
+        a, na = pip_join_points(
+            shifted, cells, cidx, edge_eps2=eps2, writeback=wb
+        )
+        m, nm = pip_join_points(
+            shifted, cells, cidx, edge_eps2=eps2, writeback=wb, lookup="mxu"
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(m), wb)
+        np.testing.assert_array_equal(np.asarray(na), np.asarray(nm), wb)
+    assert (np.asarray(a) >= 0).any()

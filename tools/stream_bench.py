@@ -78,6 +78,7 @@ def main() -> None:
         out = pip_join_points(
             shifted, cells.astype(jnp.int64), chip_index,
             heavy_cap=hcap, found_cap=fcap,
+            lookup="gather" if jax.devices()[0].platform == "cpu" else "mxu",
         )
         # device-side fold: a checksum + match count force completion
         # without streaming 4 B/point back over the link

@@ -75,6 +75,8 @@ def main() -> None:
             out = pip_join_points(
                 shifted, cells.astype(jnp.int64), chip_index,
                 heavy_cap=hcap, found_cap=fcap,
+                lookup="gather" if jax.devices()[0].platform == "cpu"
+                else "mxu",
             )
         return (out ^ (out >> 16)).sum()
 
