@@ -618,6 +618,7 @@ def main():
         if on_tpu or force_lanes:
             variants = [
                 ("scatter", "mxu"),
+                ("scatter", "mxu2"),
                 ("gather", "gather"),
                 ("gather", "mxu"),
                 ("direct", "gather"),

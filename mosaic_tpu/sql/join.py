@@ -773,7 +773,36 @@ def _tier1_rows_mxu(us: jax.Array, index: "ChipIndex"):
     return edges, ebits, geoms, cores, heavy
 
 
-def _heavy_tier(px, py, hs, index, heavy_cap, k2_default, out_len, eps2):
+def _heavy_rows_mxu(h2: jax.Array, index: "ChipIndex"):
+    """Heavy-table rows for slots ``h2`` via the one-hot MXU lookup —
+    same exactness argument as :func:`_tier1_rows_mxu` (the heavy one-hot
+    is tiny: (K2, H) with H typically < 128)."""
+    H, E2 = index.heavy_ebits.shape
+    M2 = index.heavy_slot_geom.shape[1]
+    eb = index.heavy_ebits
+    tab = jnp.concatenate(
+        [
+            index.heavy_edges.reshape(H, E2 * 4).astype(jnp.float32),
+            (eb >> jnp.uint32(16)).astype(jnp.float32),
+            (eb & jnp.uint32(0xFFFF)).astype(jnp.float32),
+            index.heavy_slot_geom.astype(jnp.float32),
+        ],
+        axis=1,
+    )
+    out = _mm_rows(h2, tab)
+    o = E2 * 4
+    edges = out[:, :o].reshape(-1, E2, 4)
+    hi16, lo16 = out[:, o : o + E2], out[:, o + E2 : o + 2 * E2]
+    ebits = (hi16.astype(jnp.uint32) << jnp.uint32(16)) | lo16.astype(
+        jnp.uint32
+    )
+    geoms = out[:, o + 2 * E2 : o + 2 * E2 + M2].astype(jnp.int32)
+    return edges, ebits, geoms
+
+
+def _heavy_tier(
+    px, py, hs, index, heavy_cap, k2_default, out_len, eps2, lookup="gather"
+):
     """Tier 2, shared by every probe plumbing mode: compact the rows whose
     cell is heavy, probe the wide rows, scatter back to ``out_len``.
 
@@ -783,14 +812,14 @@ def _heavy_tier(px, py, hs, index, heavy_cap, k2_default, out_len, eps2):
     K2 = max(8, min(K2, k2_default))
     src2, valid2, over2, _ = _compact(hs >= 0, K2)
     h2 = jnp.maximum(hs[src2], 0)
-    r2 = _ray_parity(
-        px[src2], py[src2], index.heavy_edges[h2], index.heavy_ebits[h2],
-        eps2=eps2,
-    )
+    if lookup == "mxu":
+        hedges, hebits, hgeoms = _heavy_rows_mxu(h2, index)
+    else:
+        hedges, hebits = index.heavy_edges[h2], index.heavy_ebits[h2]
+        hgeoms = index.heavy_slot_geom[h2]
+    r2 = _ray_parity(px[src2], py[src2], hedges, hebits, eps2=eps2)
     par2, near2 = r2 if eps2 is not None else (r2, None)
-    best2k = jnp.where(
-        valid2, _slot_best(par2, index.heavy_slot_geom[h2]), _SENTINEL
-    )
+    best2k = jnp.where(valid2, _slot_best(par2, hgeoms), _SENTINEL)
     best2 = jnp.full(out_len, _SENTINEL, dtype=jnp.int32).at[src2].min(best2k)
     near_sc = (
         jnp.zeros(out_len, bool).at[src2].max(near2 & valid2)
@@ -845,9 +874,9 @@ def pip_join_points(
         raise ValueError(
             f"writeback must be scatter|gather|direct, got {writeback!r}"
         )
-    if lookup not in ("gather", "mxu"):
-        raise ValueError(f"lookup must be gather|mxu, got {lookup!r}")
-    if lookup == "mxu" and (
+    if lookup not in ("gather", "mxu", "mxu2"):
+        raise ValueError(f"lookup must be gather|mxu|mxu2, got {lookup!r}")
+    if lookup != "gather" and (
         writeback == "direct" or index.cell_edges.dtype != jnp.float32
     ):
         # direct mode probes ALL N points (a (N, U) one-hot would not
@@ -894,7 +923,7 @@ def pip_join_points(
     px, py = points[src1, 0], points[src1, 1]
 
     banded = edge_eps2 is not None
-    if lookup == "mxu":
+    if lookup in ("mxu", "mxu2"):
         edges1, ebits1, geoms1, cores1, heavy1 = _tier1_rows_mxu(us, index)
     else:
         edges1, ebits1 = index.cell_edges[us], index.cell_ebits[us]
@@ -908,8 +937,12 @@ def pip_join_points(
     if H:
         # tier 2: compact again to the points whose cell is heavy
         hs = jnp.where(valid1, heavy1, -1)
+        # measured on v5e/NYC: the MXU lookup wins tier 1 but not the
+        # 6 KB heavy rows (gathers get efficient at that row size), so
+        # "mxu" keeps tier 2 on the gather path and "mxu2" forces both
         best2, over2, near_sc = _heavy_tier(
-            px, py, hs, index, heavy_cap, K1, K1, edge_eps2
+            px, py, hs, index, heavy_cap, K1, K1, edge_eps2,
+            lookup="mxu" if lookup == "mxu2" else "gather",
         )
         best1 = jnp.minimum(best1, best2)
         # an overflowed tier-2 point has an unknown answer even if tier 1

@@ -172,9 +172,14 @@ def test_mxu_lookup_bit_exact():
         a, na = pip_join_points(
             shifted, cells, cidx, edge_eps2=eps2, writeback=wb
         )
-        m, nm = pip_join_points(
-            shifted, cells, cidx, edge_eps2=eps2, writeback=wb, lookup="mxu"
-        )
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(m), wb)
-        np.testing.assert_array_equal(np.asarray(na), np.asarray(nm), wb)
+        for lk in ("mxu", "mxu2"):
+            m, nm = pip_join_points(
+                shifted, cells, cidx, edge_eps2=eps2, writeback=wb, lookup=lk
+            )
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(m), f"{wb}/{lk}"
+            )
+            np.testing.assert_array_equal(
+                np.asarray(na), np.asarray(nm), f"{wb}/{lk}"
+            )
     assert (np.asarray(a) >= 0).any()
