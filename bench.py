@@ -275,7 +275,7 @@ def _maybe_late_tpu_retry(obj: dict) -> dict:
     return obj
 
 
-_CACHE_VERSION = 5  # bump when ChipIndex/HostRecheck layout changes
+_CACHE_VERSION = 6  # bump when ChipIndex/HostRecheck layout changes
 
 
 def _load_or_build_index(zones, zones_src: str, h3):

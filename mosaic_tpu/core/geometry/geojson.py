@@ -196,7 +196,11 @@ def read_feature_collection(path_or_obj) -> tuple[PackedGeometry, "list[dict]"]:
             obj = {
                 "type": "FeatureCollection",
                 "features": [
-                    json.loads(line) for line in text.splitlines() if line.strip()
+                    # RFC 8142 GeoJSON text sequences prefix records with
+                    # RS (0x1e) — strip it so OGR GeoJSONSeq files load
+                    json.loads(line.lstrip("\x1e"))
+                    for line in text.splitlines()
+                    if line.strip("\x1e").strip()
                 ],
             }
     else:

@@ -239,14 +239,19 @@ def unit_ijk_to_digit(i, j, k, xp=np):
 def unit_ijk_to_digit_i32(i, j, k, xp=np):
     """`unit_ijk_to_digit` in int32 — the device hot path avoids emulated
     int64 arithmetic on TPU (int64 only appears in the final bit packing).
+
+    H3's unit vectors encode the digit directly in their components
+    (UNIT_VECS[d] == (d>>2, (d>>1)&1, d&1), asserted in tests), so the
+    digit is ``4i + 2j + k`` guarded by a unit-vector check — 8 fused
+    VPU ops instead of the 7-way compare chain this replaced (which was
+    the largest single term of the traced cell pipeline: 8.3 ms of a
+    ~18 ms assignment at 4M points, 9 digit levels).
     """
-    digit = xp.full(i.shape, C.INVALID_DIGIT, dtype=np.int32)
-    uv = np.asarray(C.UNIT_VECS, dtype=np.int32)
-    uv = uv if xp is np else xp.asarray(uv)
-    for d in range(7):
-        hit = (i == uv[d, 0]) & (j == uv[d, 1]) & (k == uv[d, 2])
-        digit = xp.where(hit, np.int32(d), digit)
-    return digit
+    d = 4 * i + 2 * j + k
+    # components all in {0,1} (negatives fail via sign-extended >> 1)
+    # and not (1,1,1) — everything else is INVALID_DIGIT
+    valid = (((i | j | k) >> 1) == 0) & ~((i & j & k) == 1)
+    return xp.where(valid, d.astype(np.int32), np.int32(C.INVALID_DIGIT))
 
 
 def is_class_iii(res) -> bool:

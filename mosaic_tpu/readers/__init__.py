@@ -9,7 +9,12 @@ raster->grid pipeline). `read(fmt)` mirrors `MosaicContext.read.format(...)`
 """
 
 from .registry import read  # noqa: F401
-from .vector import read_geojson, read_shapefile, read_points_csv  # noqa: F401
+from .vector import (  # noqa: F401
+    read_geojson,
+    read_points_csv,
+    read_shapefile,
+    write_geojson,
+)
 from .raster_grid import raster_to_grid, read_gdal_metadata  # noqa: F401
 from .geopackage import read_geopackage, write_geopackage  # noqa: F401
 from .filegdb import read_filegdb  # noqa: F401
@@ -22,6 +27,7 @@ __all__ = [
     "read_geojson",
     "read_shapefile",
     "read_points_csv",
+    "write_geojson",
     "read_geopackage",
     "write_geopackage",
     "read_filegdb",

@@ -180,6 +180,7 @@ _FORMATS: dict[str, Callable] = {
     "topojson": _fmt_topojson,
     "csv_wkt": _fmt_csv_wkt,  # OGR "CSV" driver with a WKT geometry field
     "flatgeobuf": _fmt_flatgeobuf,
+    "geojsonseq": _fmt_geojson,  # NDJSON / RFC 8142 both handled
 }
 
 

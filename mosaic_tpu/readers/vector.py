@@ -399,3 +399,56 @@ def open_any(path: str) -> VectorTable:
 
         return read_flatgeobuf(path)
     raise ValueError(f"no reader for {path}")
+
+
+# --------------------------------------------------------------- writers
+
+
+def _feature_props(table: VectorTable, i: int) -> dict:
+    """Row ``i``'s columns as JSON-safe properties (NaN -> null)."""
+    props: dict = {}
+    for k, col in table.columns.items():
+        v = col[i]
+        if isinstance(v, (np.floating, float)):
+            props[k] = None if np.isnan(v) else float(v)
+        elif isinstance(v, (np.integer, int)):
+            props[k] = int(v)
+        elif isinstance(v, (np.bool_, bool)):
+            props[k] = bool(v)
+        elif v is None:
+            props[k] = None
+        else:
+            props[k] = str(v)
+    return props
+
+
+def write_geojson(path: str, table: VectorTable, seq: bool = False) -> None:
+    """Write a :class:`VectorTable` as a GeoJSON FeatureCollection, or —
+    with ``seq`` — as newline-delimited GeoJSONSeq (one feature per
+    line, the OGR GeoJSONSeq driver's format). Round-trips through
+    :func:`read_geojson` / ``read("geojsonseq")``.
+
+    Reference analog: writing vector output through OGR drivers
+    (`datasource/OGRFileFormat.scala:26-47`); the reference's write side
+    goes through Spark writers, so this columnar writer is the native
+    equivalent surface.
+    """
+    import json as _json
+
+    from ..core.geometry.geojson import to_geojson_obj
+
+    geoms = to_geojson_obj(table.geometry)
+    feats = [
+        {
+            "type": "Feature",
+            "geometry": g,
+            "properties": _feature_props(table, i),
+        }
+        for i, g in enumerate(geoms)
+    ]
+    with open(path, "w") as f:
+        if seq:
+            for ft in feats:
+                f.write(_json.dumps(ft) + "\n")
+        else:
+            _json.dump({"type": "FeatureCollection", "features": feats}, f)

@@ -229,3 +229,29 @@ class TestCellMembership:
                 misses += 1
         # allow icosahedron-edge stragglers only
         assert misses <= 1, f"{misses}/400 points outside their own cell"
+
+
+def test_unit_vecs_encode_digit_bits():
+    """unit_ijk_to_digit_i32's arithmetic form relies on UNIT_VECS[d]
+    being exactly the bit decomposition of d — pin it, plus the invalid
+    cases (non-unit and (1,1,1) vectors map to INVALID_DIGIT)."""
+    import numpy as np
+
+    from mosaic_tpu.core.index.h3 import constants as C
+    from mosaic_tpu.core.index.h3.hexmath import unit_ijk_to_digit_i32
+
+    uv = np.asarray(C.UNIT_VECS)
+    for d, (i, j, k) in enumerate(uv):
+        assert (i, j, k) == (d >> 2, (d >> 1) & 1, d & 1)
+    i, j, k = (np.asarray(v, np.int32) for v in uv.T)
+    np.testing.assert_array_equal(
+        unit_ijk_to_digit_i32(i, j, k), np.arange(7, dtype=np.int32)
+    )
+    bad = np.asarray(
+        [[1, 1, 1], [2, 0, 0], [0, 2, 1], [-1, 0, 0], [0, 0, 3]], np.int32
+    )
+    i, j, k = (np.asarray(v, np.int32) for v in bad.T)
+    np.testing.assert_array_equal(
+        unit_ijk_to_digit_i32(i, j, k),
+        np.full(len(bad), C.INVALID_DIGIT, np.int32),
+    )
