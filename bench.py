@@ -31,9 +31,13 @@ re-executions can return cached results):
 - the reported time is the min over the N non-identical passes; raw pass
   times are recorded in ``detail.passes_s``.
 
-``vs_baseline`` compares against a vectorized NumPy implementation of the
-identical flat-edge join — the stand-in for the reference's JTS codegen
-path, since the reference publishes no numbers (SURVEY.md §6).
+``vs_baseline`` compares against the single-thread C++ host join
+(`native/src/evalgeom.cpp mg_eval_pip_join`, detail.baseline_kind =
+native_cpp_single_thread) — the honest analog of the reference's JTS
+codegen row path, since the reference publishes no numbers (SURVEY.md
+§6); the vectorized NumPy lane is also reported
+(detail.numpy_points_per_sec), and is the fallback baseline when the
+native toolchain is unavailable.
 
 Env knobs: MOSAIC_BENCH_PLATFORM=tpu|cpu (skip probe),
 MOSAIC_BENCH_PROBE_TIMEOUT (s/attempt, default 120),

@@ -14,6 +14,7 @@ from .vector import (  # noqa: F401
     read_points_csv,
     read_shapefile,
     write_geojson,
+    write_shapefile,
 )
 from .raster_grid import raster_to_grid, read_gdal_metadata  # noqa: F401
 from .geopackage import read_geopackage, write_geopackage  # noqa: F401
@@ -28,6 +29,7 @@ __all__ = [
     "read_shapefile",
     "read_points_csv",
     "write_geojson",
+    "write_shapefile",
     "read_geopackage",
     "write_geopackage",
     "read_filegdb",

@@ -452,3 +452,175 @@ def write_geojson(path: str, table: VectorTable, seq: bool = False) -> None:
                 f.write(_json.dumps(ft) + "\n")
         else:
             _json.dump({"type": "FeatureCollection", "features": feats}, f)
+
+
+def write_shapefile(path: str, table: VectorTable, srid: int = 4326) -> None:
+    """Write a :class:`VectorTable` as an ESRI Shapefile (.shp/.shx/.dbf,
+    plus a minimal .prj). One shape type per file (the format's rule):
+    the type is taken from the first non-empty geometry; empties become
+    NULL shapes. Rings are written in shapefile orientation (shells CW,
+    holes CCW — the packed column stores the opposite, so each closed
+    ring is emitted reversed). Round-trips through
+    :func:`read_shapefile`.
+
+    Reference analog: OGR's "ESRI Shapefile" driver on the write side
+    (`datasource/OGRFileFormat.scala:26-47` names the driver; the
+    reference writes through Spark/OGR, this is the native equivalent).
+    """
+    from ..core.types import GeometryType
+
+    p = Path(path)
+    col = table.geometry
+    G = len(col)
+
+    def base_type(g):
+        gt = col.geometry_type(g).base
+        if gt == GeometryType.POINT and col.geometry_type(g) == (
+            GeometryType.MULTIPOINT
+        ):
+            return _SHP_MULTIPOINT
+        return {
+            GeometryType.POINT: _SHP_POINT,
+            GeometryType.MULTIPOINT: _SHP_MULTIPOINT,
+            GeometryType.LINESTRING: _SHP_POLYLINE,
+            GeometryType.POLYGON: _SHP_POLYGON,
+        }[gt]
+
+    shape_type = _SHP_NULL
+    for g in range(G):
+        if col.geom_xy(g).shape[0]:
+            t = base_type(g)
+            if shape_type == _SHP_NULL:
+                shape_type = t
+            elif shape_type != t:
+                raise ValueError(
+                    "shapefiles hold ONE shape type; got both "
+                    f"{shape_type} and {t}"
+                )
+
+    def rings_of(g):
+        out = []
+        for pt in col.geom_parts(g):
+            for r in col.part_rings(pt):
+                xy = col.ring_xy(r)
+                if xy.shape[0]:
+                    out.append(np.asarray(xy, dtype=np.float64))
+        return out
+
+    recs: list[bytes] = []
+    for g in range(G):
+        gt = col.geometry_type(g)
+        xy = np.asarray(col.geom_xy(g), dtype=np.float64)
+        if xy.shape[0] == 0:
+            recs.append(struct.pack("<i", _SHP_NULL))
+            continue
+        if shape_type == _SHP_POINT:
+            recs.append(struct.pack("<idd", 1, xy[0, 0], xy[0, 1]))
+        elif shape_type == _SHP_MULTIPOINT:
+            bb = (xy[:, 0].min(), xy[:, 1].min(), xy[:, 0].max(), xy[:, 1].max())
+            recs.append(
+                struct.pack("<i4di", 8, *bb, xy.shape[0]) + xy.tobytes()
+            )
+        else:
+            rings = rings_of(g)
+            if shape_type == _SHP_POLYGON and gt.base == GeometryType.POLYGON:
+                # packed shells are CCW / holes CW; shp wants the reverse
+                rings = [r[::-1] for r in rings]
+            pts = np.concatenate(rings, axis=0)
+            parts, off = [], 0
+            for r in rings:
+                parts.append(off)
+                off += r.shape[0]
+            bb = (
+                pts[:, 0].min(), pts[:, 1].min(),
+                pts[:, 0].max(), pts[:, 1].max(),
+            )
+            recs.append(
+                struct.pack("<i4dii", shape_type, *bb, len(rings), off)
+                + np.asarray(parts, "<i4").tobytes()
+                + np.ascontiguousarray(pts).tobytes()
+            )
+
+    vb = [col.geom_xy(g) for g in range(G) if col.geom_xy(g).shape[0]]
+    allv = np.concatenate(vb, axis=0) if vb else np.zeros((1, 2))
+    bbox = (
+        float(allv[:, 0].min()), float(allv[:, 1].min()),
+        float(allv[:, 0].max()), float(allv[:, 1].max()),
+    )
+
+    def header(total_words: int) -> bytes:
+        return (
+            struct.pack(">i5i i", 9994, 0, 0, 0, 0, 0, total_words)
+            + struct.pack("<ii", 1000, shape_type)
+            + struct.pack("<4d", *bbox)
+            + struct.pack("<4d", 0, 0, 0, 0)
+        )
+
+    shp = bytearray()
+    shx = bytearray()
+    off_words = 50
+    for i, rec in enumerate(recs):
+        clen = len(rec) // 2
+        shp += struct.pack(">ii", i + 1, clen) + rec
+        shx += struct.pack(">ii", off_words, clen)
+        off_words += 4 + clen
+    p.with_suffix(".shp").write_bytes(header(off_words) + shp)
+    p.with_suffix(".shx").write_bytes(header(50 + 4 * G) + shx)
+
+    # DBF: N for numerics, L for bools, C otherwise
+    fields = []
+    for k, v in table.columns.items():
+        a = np.asarray(v)
+        if np.issubdtype(a.dtype, np.floating):
+            fields.append((k[:10], "N", 19, 7))
+        elif np.issubdtype(a.dtype, np.integer):
+            fields.append((k[:10], "N", 18, 0))
+        elif a.dtype == bool:
+            fields.append((k[:10], "L", 1, 0))
+        else:
+            w = max([1] + [len(str(x).encode("latin-1", "replace"))
+                           for x in a])
+            fields.append((k[:10], "C", min(254, w), 0))
+    rec_len = 1 + sum(f[2] for f in fields)
+    hdr_len = 33 + 32 * len(fields)
+    dbf = bytearray(
+        struct.pack("<BBBBIHH20x", 3, 26, 7, 31, G, hdr_len, rec_len)
+    )
+    for name, ft, fl, fd in fields:
+        dbf += struct.pack(
+            "<11sc4xBB14x", name.encode("ascii", "replace"), ft.encode(),
+            fl, fd,
+        )
+    dbf += b"\x0d"
+    names = list(table.columns)
+    for g in range(G):
+        dbf += b" "
+        for (name, ft, fl, fd), k in zip(fields, names):
+            v = table.columns[k][g]
+            if ft == "N":
+                s = (f"{v:.{fd}f}" if fd else str(int(v))) if not (
+                    isinstance(v, float) and np.isnan(v)
+                ) else ""
+                dbf += s.rjust(fl)[:fl].encode("ascii", "replace")
+            elif ft == "L":
+                dbf += b"T" if v else b"F"
+            else:
+                dbf += str(v).encode("latin-1", "replace")[:fl].ljust(fl)
+    dbf += b"\x1a"
+    p.with_suffix(".dbf").write_bytes(dbf)
+
+    prj = {
+        4326: 'GEOGCS["GCS_WGS_1984",DATUM["D_WGS_1984",SPHEROID'
+              '["WGS_1984",6378137.0,298.257223563]],PRIMEM["Greenwich",0.0],'
+              'UNIT["Degree",0.0174532925199433]]',
+        27700: 'PROJCS["British_National_Grid_OSGB",GEOGCS["GCS_OSGB_1936",'
+               'DATUM["D_OSGB_1936",SPHEROID["Airy_1830",6377563.396,'
+               '299.3249646]],PRIMEM["Greenwich",0.0],UNIT["Degree",'
+               '0.0174532925199433]],PROJECTION["Transverse_Mercator"]]',
+        3857: 'PROJCS["WGS_1984_Web_Mercator_Auxiliary_Sphere(Pseudo-Mercator)"'
+              ',GEOGCS["GCS_WGS_1984",DATUM["D_WGS_1984",SPHEROID["WGS_1984",'
+              '6378137.0,298.257223563]],PRIMEM["Greenwich",0.0],'
+              'UNIT["Degree",0.0174532925199433]]]',
+    }.get(srid)
+    if prj:
+        p.with_suffix(".prj").write_text(prj)
