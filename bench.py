@@ -705,6 +705,17 @@ def main():
                     pal_times.append(time.perf_counter() - t0)
                 pal_s = max(min(pal_times) - rtt, 1e-9)
                 detail["pallas_points_per_sec"] = round(n_pal / pal_s, 1)
+                # pts/s alone misreads: this kernel is BRUTE FORCE
+                # (every point x every zone x every edge — no index), so
+                # also report the arithmetic rate it sustains. ~8 VPU
+                # flops per (point, zone-slot, edge) crossing test.
+                E_pal, G_pal = int(planes.shape[1]), int(planes.shape[2])
+                detail["pallas_brute_force_work"] = (
+                    f"{n_pal} pts x {G_pal} zone slots x {E_pal} edges"
+                )
+                detail["pallas_achieved_gflops"] = round(
+                    8.0 * n_pal * G_pal * E_pal / pal_s / 1e9, 1
+                )
                 m, _ = _stats(out0)
                 detail["pallas_match_rate"] = round(int(m) / n_pal, 4)
             except Exception as e:  # kernel failure must not kill the bench
