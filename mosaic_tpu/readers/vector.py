@@ -186,15 +186,27 @@ def _read_dbf(path: Path) -> dict[str, np.ndarray]:
 
 
 def _read_prj_srid(path: Path) -> int:
-    """Best-effort EPSG from the .prj WKT."""
+    """srid from the .prj WKT — fully parsed when possible.
+
+    `core.crs_wkt.register_prj_text` lowers the WKT1 tree to a PROJ
+    string and registers it (declared EPSG code, or a stable synthetic
+    code), so `st_transform` works for ANY projection family the CRS
+    engine implements, not just a recognized-name allowlist. Malformed
+    or exotic WKT falls back to the old substring heuristic."""
     if not path.exists():
         return 4326
-    wkt = path.read_text(errors="replace").upper()
-    if "OSGB" in wkt or "27700" in wkt:
-        return 27700
-    if "PSEUDO-MERCATOR" in wkt or "3857" in wkt:
-        return 3857
-    return 4326
+    text = path.read_text(errors="replace")
+    try:
+        from ..core.crs_wkt import register_prj_text
+
+        return register_prj_text(text)
+    except Exception:
+        up = text.upper()
+        if "OSGB" in up or "27700" in up:
+            return 27700
+        if "PSEUDO-MERCATOR" in up or "3857" in up:
+            return 3857
+        return 4326
 
 
 def read_shapefile(path: str) -> VectorTable:
@@ -612,15 +624,24 @@ def write_shapefile(path: str, table: VectorTable, srid: int = 4326) -> None:
     prj = {
         4326: 'GEOGCS["GCS_WGS_1984",DATUM["D_WGS_1984",SPHEROID'
               '["WGS_1984",6378137.0,298.257223563]],PRIMEM["Greenwich",0.0],'
-              'UNIT["Degree",0.0174532925199433]]',
+              'UNIT["Degree",0.0174532925199433],'
+              'AUTHORITY["EPSG","4326"]]',
         27700: 'PROJCS["British_National_Grid_OSGB",GEOGCS["GCS_OSGB_1936",'
                'DATUM["D_OSGB_1936",SPHEROID["Airy_1830",6377563.396,'
                '299.3249646]],PRIMEM["Greenwich",0.0],UNIT["Degree",'
-               '0.0174532925199433]],PROJECTION["Transverse_Mercator"]]',
+               '0.0174532925199433]],PROJECTION["Transverse_Mercator"],'
+               'PARAMETER["latitude_of_origin",49],'
+               'PARAMETER["central_meridian",-2],'
+               'PARAMETER["scale_factor",0.9996012717],'
+               'PARAMETER["false_easting",400000],'
+               'PARAMETER["false_northing",-100000],UNIT["metre",1],'
+               'AUTHORITY["EPSG","27700"]]',
         3857: 'PROJCS["WGS_1984_Web_Mercator_Auxiliary_Sphere(Pseudo-Mercator)"'
               ',GEOGCS["GCS_WGS_1984",DATUM["D_WGS_1984",SPHEROID["WGS_1984",'
               '6378137.0,298.257223563]],PRIMEM["Greenwich",0.0],'
-              'UNIT["Degree",0.0174532925199433]]]',
+              'UNIT["Degree",0.0174532925199433]],'
+              'PROJECTION["Mercator_Auxiliary_Sphere"],'
+              'UNIT["Meter",1.0],AUTHORITY["EPSG","3857"]]',
     }.get(srid)
     if prj:
         p.with_suffix(".prj").write_text(prj)

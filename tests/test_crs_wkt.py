@@ -1,0 +1,115 @@
+"""WKT1 CRS parsing (.prj sidecars) — `core/crs_wkt.py`.
+
+Reference analog: proj4j resolves arbitrary CRS text for
+`MosaicGeometry.transformCRSXY` (`core/geometry/MosaicGeometry.scala:
+102-128`); here WKT lowers to a PROJ string for the native CRS engine.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.crs import to_wgs84
+from mosaic_tpu.core.crs_proj import crs_from_wgs84, crs_to_wgs84, lookup
+from mosaic_tpu.core.crs_wkt import (
+    parse_crs_wkt,
+    register_prj_text,
+    srid_of_wkt,
+    wkt_to_proj_string,
+)
+
+BNG = (
+    'PROJCS["OSGB 1936 / British National Grid",GEOGCS["OSGB 1936",'
+    'DATUM["OSGB_1936",SPHEROID["Airy 1830",6377563.396,299.3249646],'
+    "TOWGS84[446.448,-125.157,542.06,0.15,0.247,0.842,-20.489]],"
+    'PRIMEM["Greenwich",0],UNIT["degree",0.0174532925199433]],'
+    'PROJECTION["Transverse_Mercator"],'
+    'PARAMETER["latitude_of_origin",49],PARAMETER["central_meridian",-2],'
+    'PARAMETER["scale_factor",0.9996012717],'
+    'PARAMETER["false_easting",400000],PARAMETER["false_northing",-100000],'
+    'UNIT["metre",1],AUTHORITY["EPSG","27700"]]'
+)
+
+TX_FEET = (
+    'PROJCS["NAD_1983_StatePlane_Texas_Central_FIPS_4203_Feet",'
+    'GEOGCS["GCS_North_American_1983",DATUM["D_North_American_1983",'
+    'SPHEROID["GRS_1980",6378137.0,298.257222101]],PRIMEM["Greenwich",0.0],'
+    'UNIT["Degree",0.0174532925199433]],'
+    'PROJECTION["Lambert_Conformal_Conic"],'
+    'PARAMETER["False_Easting",2296583.333333333],'
+    'PARAMETER["False_Northing",9842500.0],'
+    'PARAMETER["Central_Meridian",-100.333333333333],'
+    'PARAMETER["Standard_Parallel_1",30.1166666666667],'
+    'PARAMETER["Standard_Parallel_2",31.8833333333333],'
+    'PARAMETER["Latitude_Of_Origin",29.6666666666667],'
+    'UNIT["Foot_US",0.3048006096012192]]'
+)
+
+WEB_MERC = (
+    'PROJCS["WGS_1984_Web_Mercator_Auxiliary_Sphere",GEOGCS["GCS_WGS_1984",'
+    'DATUM["D_WGS_1984",SPHEROID["WGS_1984",6378137.0,298.257223563]],'
+    'PRIMEM["Greenwich",0.0],UNIT["Degree",0.0174532925199433]],'
+    'PROJECTION["Mercator_Auxiliary_Sphere"],PARAMETER["False_Easting",0.0],'
+    'PARAMETER["False_Northing",0.0],PARAMETER["Central_Meridian",0.0],'
+    'PARAMETER["Standard_Parallel_1",0.0],'
+    'PARAMETER["Auxiliary_Sphere_Type",0.0],UNIT["Meter",1.0]]'
+)
+
+
+def test_bng_wkt_matches_builtin_27700():
+    assert srid_of_wkt(BNG) == 27700
+    crs = parse_crs_wkt(BNG)
+    pt = np.array([[529090.0, 181680.0]])  # central London
+    a = np.asarray(crs_to_wgs84(crs, pt))
+    b = np.asarray(to_wgs84(pt, 27700))
+    assert np.abs(a - b).max() < 2e-6  # ~0.2 m: same datum shift + tmerc
+
+
+def test_esri_feet_state_plane_registers_synthetic():
+    srid = register_prj_text(TX_FEET)
+    assert lookup(srid) is not None
+    crs = lookup(srid)
+    xy = np.asarray(crs_from_wgs84(crs, np.array([[-97.74, 30.27]])))
+    back = np.asarray(crs_to_wgs84(crs, xy))
+    np.testing.assert_allclose(back, [[-97.74, 30.27]], atol=1e-8)
+    assert 2.8e6 < xy[0, 0] < 3.4e6  # Austin easting lands in US feet
+    # same WKT -> same synthetic code (stable)
+    assert register_prj_text(TX_FEET) == srid
+
+
+def test_web_mercator_auxiliary_sphere_is_spherical():
+    crs = parse_crs_wkt(WEB_MERC)
+    xy = np.asarray(crs_from_wgs84(crs, np.array([[-74.0, 40.7]])))
+    # decode through the builtin spherical 3857
+    back = np.asarray(to_wgs84(xy, 3857))
+    assert np.abs(back - [[-74.0, 40.7]]).max() < 1e-6
+
+
+def test_geogcs_only_is_longlat():
+    s = wkt_to_proj_string(
+        'GEOGCS["GCS_WGS_1984",DATUM["D_WGS_1984",SPHEROID["WGS_1984",'
+        '6378137.0,298.257223563]],PRIMEM["Greenwich",0.0],'
+        'UNIT["Degree",0.0174532925199433]]'
+    )
+    assert s.startswith("+proj=longlat")
+
+
+def test_unknown_projection_raises():
+    bad = BNG.replace("Transverse_Mercator", "Space_Oblique_Mercator")
+    with pytest.raises(ValueError, match="unsupported PROJECTION"):
+        wkt_to_proj_string(bad)
+
+
+def test_prj_sidecar_drives_shapefile_srid(tmp_path):
+    from mosaic_tpu.core.geometry import wkt as wktmod
+    from mosaic_tpu.readers.vector import (
+        VectorTable,
+        read_shapefile,
+        write_shapefile,
+    )
+
+    col = wktmod.from_wkt(["POINT (529090 181680)"])
+    t = VectorTable(geometry=col, columns={})
+    p = tmp_path / "uk.shp"
+    write_shapefile(str(p), t, srid=27700)
+    r = read_shapefile(str(p))
+    assert int(r.geometry.srid[0]) == 27700
