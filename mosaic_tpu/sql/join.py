@@ -66,6 +66,11 @@ MAX_SLOTS = 32
 #: (unknown result; raise the cap — `pip_join` sizes it exactly)
 OVERFLOW = -2
 
+#: direct-mode tier-1 chunk rows (keeps the un-compacted (CH, E1, 4)
+#: edge intermediate under XLA's 2 GB buffer limit); tests shrink it to
+#: exercise the lax.map path on small inputs
+_DIRECT_CHUNK = 1 << 20
+
 #: epsilon-band multipliers (SURVEY §7 precision strategy): a point is
 #: borderline when its cell-rounding margin (`IndexSystem.
 #: point_to_cell_margin`) is below CELL_MARGIN_K·eps(dtype) — calibrated
@@ -927,15 +932,46 @@ def pip_join_points(
 
     if writeback == "direct":
         us = jnp.maximum(u, 0)
-        r1 = _ray_parity(
-            points[:, 0], points[:, 1],
-            index.cell_edges[us], index.cell_ebits[us],
-            eps2=edge_eps2,
-        )
-        parity, near1 = r1 if banded_d else (r1, None)
-        best = _slot_best(
-            parity, index.cell_slot_geom[us], index.cell_slot_core[us]
-        )
+
+        def _direct_tier1(args):
+            px_c, py_c, us_c = args
+            r = _ray_parity(
+                px_c, py_c,
+                index.cell_edges[us_c], index.cell_ebits[us_c],
+                eps2=edge_eps2,
+            )
+            par, near = r if banded_d else (r, None)
+            b = _slot_best(
+                par, index.cell_slot_geom[us_c], index.cell_slot_core[us_c]
+            )
+            return (b, near) if banded_d else b
+
+        # the un-compacted (N, E1, 4) edge intermediate crosses XLA's
+        # 2 GB buffer limit above ~2M points (tpu_compile_helper crash,
+        # observed at 4M on v5e): chunk the tier-1 row work via lax.map
+        CH = _DIRECT_CHUNK
+        if N > CH:
+            pad = (-N) % CH
+            px_p = jnp.pad(points[:, 0], (0, pad))
+            py_p = jnp.pad(points[:, 1], (0, pad))
+            us_p = jnp.pad(us, (0, pad))
+            n_ch = (N + pad) // CH
+            res = jax.lax.map(
+                _direct_tier1,
+                (
+                    px_p.reshape(n_ch, CH),
+                    py_p.reshape(n_ch, CH),
+                    us_p.reshape(n_ch, CH),
+                ),
+            )
+            if banded_d:
+                best = res[0].reshape(-1)[:N]
+                near1 = res[1].reshape(-1)[:N]
+            else:
+                best = res.reshape(-1)[:N]
+        else:
+            r1 = _direct_tier1((points[:, 0], points[:, 1], us))
+            best, near1 = r1 if banded_d else (r1, None)
         best = jnp.where(found, best, _SENTINEL)
         if H:
             hs = jnp.where(found, index.cell_heavy[us], -1)
