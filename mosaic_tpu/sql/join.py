@@ -862,9 +862,20 @@ def _heavy_tier(
     r2 = _ray_parity(pq2[:, 0], pq2[:, 1], hedges, hebits, eps2=eps2)
     par2, near2 = r2 if eps2 is not None else (r2, None)
     best2k = jnp.where(valid2, _slot_best(par2, hgeoms), _SENTINEL)
-    best2 = jnp.full(out_len, _SENTINEL, dtype=jnp.int32).at[src2].min(best2k)
+    # unique no-combiner scatter back (see _compact): valid src2 row ids
+    # are unique; invalid slots drop via distinct out-of-bounds dests
+    dest2 = jnp.where(
+        valid2, src2, out_len + jnp.arange(src2.shape[0], dtype=jnp.int32)
+    )
+    best2 = (
+        jnp.full(out_len, _SENTINEL, dtype=jnp.int32)
+        .at[dest2]
+        .set(best2k, unique_indices=True, mode="drop")
+    )
     near_sc = (
-        jnp.zeros(out_len, bool).at[src2].max(near2 & valid2)
+        jnp.zeros(out_len, bool)
+        .at[dest2]
+        .set(near2, unique_indices=True, mode="drop")
         if eps2 is not None
         else None
     )
@@ -1027,15 +1038,22 @@ def pip_join_points(
         if banded:
             near1 = near1 | near_sc
 
-    # return compacted results to the full point axis
+    # return compacted results to the full point axis. Valid src1 row ids
+    # are unique by construction; invalid slots divert to distinct
+    # out-of-bounds destinations that mode="drop" discards — a unique
+    # no-combiner scatter (see _compact for the measured win over
+    # combiner scatters).
     if writeback == "gather":
         slot = jnp.clip(pos1, 0, K1 - 1)
         best = jnp.where(found, best1[slot], _SENTINEL)
     else:
+        wdest = jnp.where(
+            valid1, src1, N + jnp.arange(K1, dtype=jnp.int32)
+        )
         best = (
             jnp.full(N, _SENTINEL, dtype=jnp.int32)
-            .at[src1]
-            .min(jnp.where(valid1, best1, _SENTINEL))
+            .at[wdest]
+            .set(best1, unique_indices=True, mode="drop")
         )
     out = jnp.where(best == _SENTINEL, -1, best).astype(jnp.int32)
     out = jnp.where(best == _OVF_MARK, OVERFLOW, out)
@@ -1044,7 +1062,11 @@ def pip_join_points(
         if writeback == "gather":
             near = found & ~over1 & near1[slot]
         else:
-            near = jnp.zeros(N, bool).at[src1].max(near1 & valid1)
+            near = (
+                jnp.zeros(N, bool)
+                .at[wdest]
+                .set(near1, unique_indices=True, mode="drop")
+            )
         return out, near
     return out
 
