@@ -861,7 +861,7 @@ def _heavy_tier(
     pq2 = jnp.stack([px, py], axis=1)[src2]
     r2 = _ray_parity(pq2[:, 0], pq2[:, 1], hedges, hebits, eps2=eps2)
     par2, near2 = r2 if eps2 is not None else (r2, None)
-    best2k = jnp.where(valid2, _slot_best(par2, hgeoms), _SENTINEL)
+    best2k = _slot_best(par2, hgeoms)  # invalid slots never land (drop)
     # unique no-combiner scatter back (see _compact): valid src2 row ids
     # are unique; invalid slots drop via distinct out-of-bounds dests
     dest2 = jnp.where(
@@ -915,8 +915,9 @@ def pip_join_points(
 
     ``writeback`` picks the probe plumbing — identical results, a TPU
     autotuning knob the bench measures and picks the winner of:
-    ``"scatter"`` compacts found points then returns results via sorted
-    scatter-min; ``"gather"`` compacts but inverts by per-point gather of
+    ``"scatter"`` compacts found points then returns results via a
+    unique-destination set scatter; ``"gather"`` compacts but inverts by
+    per-point gather of
     the prefix slot; ``"direct"`` skips tier-1 compaction entirely —
     every point gathers its own 512 B edge row (wasted gathers on misses,
     but no prefix scan, no point permutation and no writeback, which cost
@@ -1033,7 +1034,8 @@ def pip_join_points(
         )
         best1 = jnp.minimum(best1, best2)
         # an overflowed tier-2 point has an unknown answer even if tier 1
-        # hit: mark it (marker < SENTINEL so the scatter-min keeps it)
+        # hit: mark it (each compacted row writes its own unique slot, so
+        # the mark survives the writeback scatter verbatim)
         best1 = jnp.where(over2, _OVF_MARK, best1)
         if banded:
             near1 = near1 | near_sc
