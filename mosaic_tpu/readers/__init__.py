@@ -8,7 +8,7 @@ raster->grid pipeline). `read(fmt)` mirrors `MosaicContext.read.format(...)`
 (`functions/MosaicContext.scala:802`).
 """
 
-from .registry import read  # noqa: F401
+from .registry import read, write  # noqa: F401
 from .vector import (  # noqa: F401
     read_geojson,
     read_points_csv,
@@ -25,6 +25,7 @@ from .zarr_store import ZarrStore, read_zarr  # noqa: F401
 
 __all__ = [
     "read",
+    "write",
     "read_geojson",
     "read_shapefile",
     "read_points_csv",

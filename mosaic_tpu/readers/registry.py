@@ -189,3 +189,76 @@ def read(fmt: str) -> _Reader:
     if fmt not in _FORMATS:
         raise ValueError(f"unknown format {fmt!r}; have {sorted(_FORMATS)}")
     return _Reader(fmt)
+
+
+# ----------------------------------------------------------------- write
+
+
+class _Writer:
+    """`write(fmt).option(...).save(path, table)` — the write-side mirror
+    of :func:`read` (the reference writes through Spark's
+    `df.write.format(...)` + OGR drivers; these are the native columnar
+    writers)."""
+
+    def __init__(self, fmt: str):
+        self.fmt = fmt
+        self.options: dict = {}
+
+    def option(self, key: str, value) -> "_Writer":
+        self.options[key] = value
+        return self
+
+    def save(self, path, table, **kwargs) -> None:
+        merged = {**self.options, **kwargs}
+        _WRITE_FORMATS[self.fmt](path, table, **merged)
+
+
+def _wfmt_geojson(path, table, **kw):
+    from .vector import write_geojson
+
+    write_geojson(path, table, seq=bool(kw.get("seq", False)))
+
+
+def _wfmt_geojsonseq(path, table, **kw):
+    from .vector import write_geojson
+
+    write_geojson(path, table, seq=True)
+
+
+def _wfmt_shapefile(path, table, **kw):
+    from .vector import write_shapefile
+
+    write_shapefile(path, table, srid=int(kw.get("srid", 4326)))
+
+
+def _wfmt_flatgeobuf(path, table, **kw):
+    from .flatgeobuf import write_flatgeobuf
+
+    write_flatgeobuf(
+        path, table, name=kw.get("name", "layer"),
+        srid=int(kw.get("srid", 4326)),
+    )
+
+
+def _wfmt_geopackage(path, table, **kw):
+    from .geopackage import write_geopackage
+
+    write_geopackage(path, table, **kw)
+
+
+_WRITE_FORMATS: dict[str, Callable] = {
+    "geojson": _wfmt_geojson,
+    "geojsonseq": _wfmt_geojsonseq,
+    "shapefile": _wfmt_shapefile,
+    "flatgeobuf": _wfmt_flatgeobuf,
+    "geopackage": _wfmt_geopackage,
+}
+
+
+def write(fmt: str) -> _Writer:
+    """`write("shapefile").option("srid", 27700).save(path, table)`."""
+    if fmt not in _WRITE_FORMATS:
+        raise ValueError(
+            f"unknown write format {fmt!r}; have {sorted(_WRITE_FORMATS)}"
+        )
+    return _Writer(fmt)

@@ -1224,3 +1224,38 @@ def test_write_geojson_seq_round_trip(tmp_path):
     r = read("geojsonseq").load(str(p))
     assert len(r) == 2 and np.isnan(r.columns["v"][0])
     assert "LINESTRING" in wkt.to_wkt(r.geometry)[1]
+
+
+def test_write_registry_round_trips(tmp_path):
+    """write(fmt).save -> read(fmt).load across every registered writer."""
+    import numpy as np
+
+    from mosaic_tpu.core.geometry import wkt
+    from mosaic_tpu.readers import read, write
+    from mosaic_tpu.readers.vector import VectorTable
+
+    col = wkt.from_wkt(
+        ["POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))", "POLYGON ((5 5, 9 5, 9 9, 5 9, 5 5))"]
+    )
+    t = VectorTable(
+        geometry=col,
+        columns={"v": np.asarray([1.5, 2.5])},
+    )
+    cases = {
+        "geojson": "a.geojson",
+        "geojsonseq": "a.geojsonl",
+        "shapefile": "a.shp",
+        "flatgeobuf": "a.fgb",
+        "geopackage": "a.gpkg",
+    }
+    for fmt, name in cases.items():
+        p = str(tmp_path / name)
+        write(fmt).save(p, t)
+        r = read(fmt).load(p)
+        assert len(r) == 2, fmt
+        np.testing.assert_allclose(
+            np.sort(np.asarray(r.columns["v"], float)), [1.5, 2.5],
+            err_msg=fmt,
+        )
+        ws = " ".join(wkt.to_wkt(r.geometry))
+        assert ws.count("POLYGON") == 2, (fmt, ws)
