@@ -20,6 +20,7 @@ from .raster_grid import raster_to_grid, read_gdal_metadata  # noqa: F401
 from .geopackage import read_geopackage, write_geopackage  # noqa: F401
 from .filegdb import read_filegdb  # noqa: F401
 from .grib2 import read_grib2  # noqa: F401
+from .osm import read_osm  # noqa: F401
 from .hdf5_lite import H5Lite, read_netcdf  # noqa: F401
 from .zarr_store import ZarrStore, read_zarr  # noqa: F401
 
@@ -35,6 +36,7 @@ __all__ = [
     "write_geopackage",
     "read_filegdb",
     "read_grib2",
+    "read_osm",
     "read_netcdf",
     "H5Lite",
     "read_zarr",

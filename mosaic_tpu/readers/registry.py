@@ -159,6 +159,12 @@ def _fmt_csv_wkt(path, **kw):
     )
 
 
+def _fmt_osm(path, **kw):
+    from .osm import read_osm
+
+    return read_osm(path)
+
+
 _FORMATS: dict[str, Callable] = {
     "kml": _fmt_kml,
     "gml": _fmt_gml,
@@ -181,6 +187,7 @@ _FORMATS: dict[str, Callable] = {
     "csv_wkt": _fmt_csv_wkt,  # OGR "CSV" driver with a WKT geometry field
     "flatgeobuf": _fmt_flatgeobuf,
     "geojsonseq": _fmt_geojson,  # NDJSON / RFC 8142 both handled
+    "osm": _fmt_osm,
 }
 
 

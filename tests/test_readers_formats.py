@@ -1259,3 +1259,47 @@ def test_write_registry_round_trips(tmp_path):
         )
         ws = " ".join(wkt.to_wkt(r.geometry))
         assert ws.count("POLYGON") == 2, (fmt, ws)
+
+
+def test_osm_reader(tmp_path):
+    """OSM XML: tagged nodes -> points, closed area-tagged ways ->
+    polygons, highways stay lines, multipolygon relations chain their
+    member ways into rings (reference: the OGR OSM driver behind
+    OGRFileFormat.scala:26-47)."""
+    import numpy as np
+
+    from mosaic_tpu.core.geometry import wkt
+    from mosaic_tpu.readers import read
+
+    osm = """<?xml version='1.0'?>
+<osm version="0.6">
+ <node id="1" lat="40.0" lon="-74.0"><tag k="amenity" v="cafe"/></node>
+ <node id="2" lat="40.001" lon="-74.0"/>
+ <node id="3" lat="40.001" lon="-73.999"/>
+ <node id="4" lat="40.0" lon="-73.999"/>
+ <node id="5" lat="40.0" lon="-74.0"/>
+ <way id="100"><nd ref="5"/><nd ref="2"/><nd ref="3"/><nd ref="4"/>
+   <nd ref="5"/><tag k="building" v="yes"/></way>
+ <way id="101"><nd ref="2"/><nd ref="3"/>
+   <tag k="highway" v="residential"/></way>
+ <way id="200"><nd ref="5"/><nd ref="2"/><nd ref="3"/></way>
+ <way id="201"><nd ref="3"/><nd ref="4"/><nd ref="5"/></way>
+ <relation id="300"><tag k="type" v="multipolygon"/>
+   <member type="way" ref="200" role="outer"/>
+   <member type="way" ref="201" role="outer"/></relation>
+</osm>"""
+    p = tmp_path / "x.osm"
+    p.write_text(osm)
+    t = read("osm").load(str(p))
+    kinds = list(t.columns["kind"])
+    assert kinds == ["point", "polygon", "line", "multipolygon"]
+    assert list(t.columns["osm_id"]) == [1, 100, 101, 300]
+    w = wkt.to_wkt(t.geometry)
+    assert w[0].startswith("POINT") and w[1].startswith("POLYGON")
+    from mosaic_tpu.core.geometry import oracle
+
+    # the relation's chained rings enclose the same square as way 100
+    inside = oracle.contains_points(
+        t.geometry, 3, np.asarray([[-73.9995, 40.0005]])
+    )
+    assert bool(inside[0])
