@@ -455,10 +455,13 @@ def main():
 
         @functools.partial(
             jax.jit,
-            static_argnames=("found_cap", "heavy_cap", "writeback", "lookup"),
+            static_argnames=(
+                "found_cap", "heavy_cap", "writeback", "lookup", "compaction"
+            ),
         )
         def step(points_f64, chip_index, found_cap, heavy_cap,
-                 writeback="scatter", lookup="gather"):
+                 writeback="scatter", lookup="gather",
+                 compaction="scatter"):
             cells = h3.point_to_cell(points_f64.astype(cell_dtype), RES)
             shifted = (points_f64 - chip_index.border.shift).astype(dtype)
             return pip_join_points(
@@ -469,6 +472,7 @@ def main():
                 found_cap=found_cap,
                 writeback=writeback,
                 lookup=lookup,
+                compaction=compaction,
             )
 
         # full-bit XOR-shift fold: every result bit stays live (a masked
@@ -567,12 +571,14 @@ def main():
         rtt = min(rtts)
         detail["sync_rtt_s"] = round(rtt, 4)
 
-        def run_pass(sp, fc, hc, wb="scatter", lk="gather"):
+        def run_pass(sp, fc, hc, wb="scatter", lk="gather", cp="scatter"):
             """Time one pass: dispatch every batch, force completion via
             the device fold of each output pulled as one chained scalar."""
             t0 = time.perf_counter()
             outs = [
-                step(sb, index, fc, hc, writeback=wb, lookup=lk) for sb in sp
+                step(sb, index, fc, hc, writeback=wb, lookup=lk,
+                     compaction=cp)
+                for sb in sp
             ]
             tot = None
             for o in outs:
@@ -618,23 +624,30 @@ def main():
         # 2026-07-31 on v5e: scatter+mxu 63.4M vs scatter+gather 34.9M
         # pts/s). Each variant has its own try: one failure (the direct
         # lane has hit tpu_compile_helper crashes) must not lose the rest.
-        win_wb, win_lk = "scatter", "gather"
+        win_wb, win_lk, win_cp = "scatter", "gather", "scatter"
         if on_tpu or force_lanes:
             variants = [
-                ("scatter", "mxu"),
-                ("scatter", "mxu2"),
-                ("gather", "gather"),
-                ("gather", "mxu"),
-                ("direct", "gather"),
+                ("scatter", "mxu", "scatter"),
+                ("scatter", "mxu", "mxu"),
+                ("scatter", "mxu2", "scatter"),
+                ("gather", "gather", "scatter"),
+                ("gather", "mxu", "mxu"),
+                ("direct", "gather", "scatter"),
             ]
             detail["writeback"]["winner"] = "scatter"
-            for wb, lk in variants:
+            for wb, lk, cp in variants:
                 name = wb if lk == "gather" else f"{wb}+{lk}"
+                if cp != "scatter":
+                    name += "+cmxu"
                 try:
                     _prog(f"{name} variant lane")
-                    run_pass(staged_passes[0], fcap, hcap, wb=wb, lk=lk)
+                    run_pass(staged_passes[0], fcap, hcap, wb=wb, lk=lk,
+                             cp=cp)
                     v_times = [
-                        round(run_pass(sp, fcap, hcap, wb=wb, lk=lk)[0], 4)
+                        round(
+                            run_pass(sp, fcap, hcap, wb=wb, lk=lk, cp=cp)[0],
+                            4,
+                        )
                         for sp in staged_passes
                     ]
                     v_s = max(min(v_times) - rtt, 1e-9)
@@ -643,7 +656,7 @@ def main():
                     if v_s < dev_s:
                         dev_s, dev_rate = v_s, n_device / v_s
                         detail["writeback"]["winner"] = name
-                        win_wb, win_lk = wb, lk
+                        win_wb, win_lk, win_cp = wb, lk, cp
                 except Exception as e:
                     detail["writeback"][f"{name}_error"] = repr(e)[:200]
             detail["main_points_per_sec"] = round(dev_rate, 1)
@@ -765,8 +778,8 @@ def main():
                 for p, sp in enumerate(scale_passes):
                     t0 = time.perf_counter()
                     outs = [
-                        step(sb, index, fcap, hcap,
-                             writeback=win_wb, lookup=win_lk)
+                        step(sb, index, fcap, hcap, writeback=win_wb,
+                             lookup=win_lk, compaction=win_cp)
                         for sb in sp
                     ]
                     tot = None

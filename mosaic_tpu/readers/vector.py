@@ -410,6 +410,12 @@ def open_any(path: str) -> VectorTable:
         from .flatgeobuf import read_flatgeobuf
 
         return read_flatgeobuf(path)
+    if s.endswith(".osm"):
+        from .osm import read_osm
+
+        return read_osm(path)
+    if s.endswith((".geojsonl", ".ndjson", ".geojsons")):
+        return read_geojson(path)  # newline-delimited handled natively
     raise ValueError(f"no reader for {path}")
 
 

@@ -216,3 +216,69 @@ def test_direct_chunked_path_identical(monkeypatch):
     np.testing.assert_array_equal(np.asarray(a), np.asarray(d))
     np.testing.assert_array_equal(np.asarray(na), np.asarray(nd))
     assert (np.asarray(a) >= 0).any()
+
+
+def test_mxu_compaction_identical():
+    """_compact_mxu (block one-hot int8 matmuls + one small unique
+    scatter) must match _compact exactly, including pos, validity and
+    both overflow kinds (global cap + block-local s_cap)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mosaic_tpu.sql.join import _compact, _compact_mxu
+
+    rng = np.random.default_rng(0)
+    for n, p, cap, s_cap in [
+        (100000, 0.09, 16384, 256),
+        (70000, 0.5, 65536, 1280),
+        (2048, 1.0, 4096, 2048),
+    ]:
+        flag = jnp.asarray(rng.random(n) < p)
+        a = jax.jit(lambda f, cap=cap: _compact(f, cap))(flag)
+        m = jax.jit(
+            lambda f, cap=cap, s=s_cap: _compact_mxu(f, cap, s)
+        )(flag)
+        for x, y, name in zip(a, m, ("src", "valid", "over", "pos")):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), f"{n}/{p}/{name}"
+            )
+    # clustered flags exceeding s_cap in one block: dropped rows must be
+    # flagged overflow (never a silently wrong/missing result)
+    flag = np.zeros(100000, bool)
+    flag[1000:1900] = True
+    fm = jnp.asarray(flag)
+    a = [np.asarray(x) for x in _compact(fm, 4096)]
+    m = [np.asarray(x) for x in _compact_mxu(fm, 4096, 256)]
+    np.testing.assert_array_equal(a[3], m[3])
+    np.testing.assert_array_equal(m[0][:256], a[0][:256])
+    assert m[2][1256:1900].all() and not m[2][:1256].any()
+    assert not m[1][256:900].any()
+
+
+def test_compaction_knob_end_to_end():
+    import jax.numpy as jnp
+
+    from mosaic_tpu.core.index import H3
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.sql import join as J
+
+    col = wkt.from_wkt(ZONES)
+    cidx = J.build_chip_index(tessellate(col, H3, 3, keep_core_geoms=False))
+    rng = np.random.default_rng(13)
+    n = 1 << 17  # above the mxu-compaction threshold
+    pts = np.column_stack(
+        [rng.uniform(-25, 35, n), rng.uniform(-25, 20, n)]
+    )
+    cells = H3.point_to_cell(jnp.asarray(pts, jnp.float32), 3)
+    shifted = jnp.asarray(
+        pts - np.asarray(cidx.border.shift, np.float64),
+        dtype=cidx.border.verts.dtype,
+    )
+    eps2 = jnp.asarray(1e-10, cidx.border.verts.dtype)
+    a, na = J.pip_join_points(shifted, cells, cidx, edge_eps2=eps2)
+    m, nm = J.pip_join_points(
+        shifted, cells, cidx, edge_eps2=eps2, lookup="mxu",
+        compaction="mxu", compact_block=1024,
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(m))
+    np.testing.assert_array_equal(np.asarray(na), np.asarray(nm))
