@@ -713,12 +713,16 @@ def _point_chips(
     out_core: list,
     out_hasgeom: list,
     builder: GeometryBuilder,
+    cells: "np.ndarray | None" = None,
 ) -> None:
     """Reference analog: `Mosaic.pointChip` (`core/Mosaic.scala:47-58`) —
-    one non-core chip per point carrying the point geometry."""
+    one non-core chip per point carrying the point geometry. ``cells``
+    lets `tessellate` batch the cell assignment for ALL point geometries
+    in one call (4104 per-geometry calls cost 7.2 s of a KNN transform)."""
     srid = int(col.srid[g])
     pts = col.geom_xy(g)
-    cells = np.asarray(index.point_to_cell(pts, resolution)).reshape(-1)
+    if cells is None:
+        cells = np.asarray(index.point_to_cell(pts, resolution)).reshape(-1)
     for i in range(pts.shape[0]):
         out_geom_id.append(g)
         out_cell.append(int(cells[i]))
@@ -766,6 +770,21 @@ def tessellate(
                 cand_of[g] = cand_lists[t]
                 cells_of[g] = cells_all[sl]
                 klen_of[g] = klen_all[sl]
+    # batch cell assignment for ALL point geometries in one call
+    point_ids = [
+        g for g in range(len(col)) if bases[g] == GeometryType.POINT
+    ]
+    pcells_of: dict[int, np.ndarray] = {}
+    if point_ids:
+        psizes = [col.geom_xy(g).shape[0] for g in point_ids]
+        if sum(psizes):
+            allp = np.concatenate([col.geom_xy(g) for g in point_ids])
+            cells_p = np.asarray(
+                index.point_to_cell(allp, resolution)
+            ).reshape(-1)
+            poff = np.cumsum([0] + psizes)
+            for t, g in enumerate(point_ids):
+                pcells_of[g] = cells_p[poff[t] : poff[t + 1]]
     empty = (np.zeros(0, np.int64), np.zeros((0, 1, 2)), np.zeros(0, np.int64))
     for g in range(len(col)):
         base = bases[g]
@@ -799,7 +818,8 @@ def tessellate(
             )
         elif base == GeometryType.POINT:
             _point_chips(
-                col, g, index, resolution, geom_id, cell, core, hasgeom, builder
+                col, g, index, resolution, geom_id, cell, core, hasgeom,
+                builder, cells=pcells_of.get(g),
             )
         else:
             raise ValueError(f"cannot tessellate geometry type {base}")

@@ -148,6 +148,11 @@ class SpatialKNN:
         #: batch over its devices (parallel/dist_knn.py)
         self.mesh = mesh
         self.metrics: dict = {}
+        #: GridRingNeighbours per resolution — MUST survive across
+        #: transform() calls: its _dist_cache holds the jitted distance
+        #: kernels, and rebuilding it each call recompiled them every
+        #: time (~27 s per transform over the axon tunnel)
+        self._ring_cache: dict = {}
 
     # ------------------------------------------------------------ helpers
     def _cover_cells(self, col, res: int) -> list[np.ndarray]:
@@ -185,7 +190,10 @@ class SpatialKNN:
         from ..functions.geometry import _pair_pack
 
         dl, dc = _pair_pack(land, cand)
-        ring = GridRingNeighbours(self.index, res, mesh=self.mesh)
+        ring = self._ring_cache.get(res)
+        if ring is None or ring.mesh is not self.mesh:
+            ring = GridRingNeighbours(self.index, res, mesh=self.mesh)
+            self._ring_cache[res] = ring
 
         ckpt = (
             CheckpointManager(self.checkpoint_dir, overwrite=True)
