@@ -242,6 +242,19 @@ def test_mxu_compaction_identical():
             np.testing.assert_array_equal(
                 np.asarray(x), np.asarray(y), f"{n}/{p}/{name}"
             )
+        # the vals channel (4x6-bit int8 dots) must equal vals[src]
+        vals = jnp.asarray(
+            rng.integers(0, 1 << 24, n).astype(np.int32)
+        )
+        mv = jax.jit(
+            lambda f, v, cap=cap, s=s_cap: _compact_mxu(f, cap, s, vals=v)
+        )(flag, vals)
+        got_v = np.asarray(mv[4])
+        want_v = np.asarray(vals)[np.asarray(a[0])]
+        valid_np = np.asarray(a[1])
+        np.testing.assert_array_equal(
+            got_v[valid_np], want_v[valid_np], f"{n}/{p}/vals"
+        )
     # clustered flags exceeding s_cap in one block: dropped rows must be
     # flagged overflow (never a silently wrong/missing result)
     flag = np.zeros(100000, bool)
