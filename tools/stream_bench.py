@@ -79,6 +79,8 @@ def main() -> None:
             shifted, cells.astype(jnp.int64), chip_index,
             heavy_cap=hcap, found_cap=fcap,
             lookup="gather" if jax.devices()[0].platform == "cpu" else "mxu",
+            compaction="scatter" if jax.devices()[0].platform == "cpu"
+            else "mxu",
         )
         # device-side fold: checksum + match count + overflow count force
         # completion without streaming 4 B/point back over the link

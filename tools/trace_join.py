@@ -77,6 +77,8 @@ def main() -> None:
                 heavy_cap=hcap, found_cap=fcap,
                 lookup="gather" if jax.devices()[0].platform == "cpu"
                 else "mxu",
+            compaction="scatter" if jax.devices()[0].platform == "cpu"
+            else "mxu",
             )
         return (out ^ (out >> 16)).sum()
 
