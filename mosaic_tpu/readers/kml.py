@@ -160,3 +160,105 @@ def read_kml(path):
         for k in keys
     }
     return VectorTable(geometry=col, columns=columns)
+
+
+def write_kml(path: str, table, name_col: "str | None" = None) -> None:
+    """Write a VectorTable as KML Placemarks (round-trips through
+    :func:`read_kml`): Point / LineString / Polygon (outer+inner
+    boundaries) / MultiGeometry, attributes as ExtendedData/Data values.
+
+    Reference analog: OGR's KML driver write side
+    (`datasource/OGRFileFormat.scala:26-47` names the driver family)."""
+    import numpy as np
+
+    from ..core.types import GeometryType
+
+    col = table.geometry
+
+    def coords(xy):
+        return " ".join(
+            f"{float(x)!r},{float(y)!r}" for x, y in np.asarray(xy)
+        )
+
+    def polygon(rings):
+        out = ["<Polygon>"]
+        for k, r in enumerate(rings):
+            r = np.asarray(r)
+            if r.shape[0] and not np.array_equal(r[0], r[-1]):
+                r = np.concatenate([r, r[:1]])
+            tag = "outerBoundaryIs" if k == 0 else "innerBoundaryIs"
+            out.append(
+                f"<{tag}><LinearRing><coordinates>{coords(r)}"
+                f"</coordinates></LinearRing></{tag}>"
+            )
+        out.append("</Polygon>")
+        return "".join(out)
+
+    def geometry(g):
+        gt = col.geometry_type(g)
+        base = gt.base
+        if base == GeometryType.POINT and gt == GeometryType.MULTIPOINT:
+            pts = np.asarray(col.geom_xy(g))
+            return (
+                "<MultiGeometry>"
+                + "".join(
+                    f"<Point><coordinates>{coords(p[None])}"
+                    "</coordinates></Point>"
+                    for p in pts
+                )
+                + "</MultiGeometry>"
+            )
+        if base == GeometryType.POINT:
+            return (
+                f"<Point><coordinates>{coords(col.geom_xy(g))}"
+                "</coordinates></Point>"
+            )
+        if base == GeometryType.LINESTRING:
+            parts = [
+                f"<LineString><coordinates>{coords(col.ring_xy(r))}"
+                "</coordinates></LineString>"
+                for p in col.geom_parts(g)
+                for r in col.part_rings(p)
+            ]
+            if len(parts) == 1:
+                return parts[0]
+            return "<MultiGeometry>" + "".join(parts) + "</MultiGeometry>"
+        # polygons: one <Polygon> per part (shell + holes)
+        polys = [
+            polygon([col.ring_xy(r) for r in col.part_rings(p)])
+            for p in col.geom_parts(g)
+        ]
+        if len(polys) == 1:
+            return polys[0]
+        return "<MultiGeometry>" + "".join(polys) + "</MultiGeometry>"
+
+    def esc(s):
+        return (
+            str(s)
+            .replace("&", "&amp;")
+            .replace("<", "&lt;")
+            .replace(">", "&gt;")
+        )
+
+    rows = []
+    for g in range(len(col)):
+        nm = (
+            f"<name>{esc(table.columns[name_col][g])}</name>"
+            if name_col and name_col in table.columns
+            else ""
+        )
+        data = "".join(
+            f'<Data name="{esc(k)}"><value>{esc(v[g])}</value></Data>'
+            for k, v in table.columns.items()
+            if k != name_col
+        )
+        ext = f"<ExtendedData>{data}</ExtendedData>" if data else ""
+        rows.append(f"<Placemark>{nm}{ext}{geometry(g)}</Placemark>")
+    doc = (
+        '<?xml version="1.0" encoding="UTF-8"?>\n'
+        '<kml xmlns="http://www.opengis.net/kml/2.2"><Document>'
+        + "".join(rows)
+        + "</Document></kml>"
+    )
+    with open(path, "w") as f:
+        f.write(doc)

@@ -253,8 +253,15 @@ def _wfmt_geopackage(path, table, **kw):
     write_geopackage(path, table, **kw)
 
 
+def _wfmt_kml(path, table, **kw):
+    from .kml import write_kml
+
+    write_kml(path, table, name_col=kw.get("name_col"))
+
+
 _WRITE_FORMATS: dict[str, Callable] = {
     "geojson": _wfmt_geojson,
+    "kml": _wfmt_kml,
     "geojsonseq": _wfmt_geojsonseq,
     "shapefile": _wfmt_shapefile,
     "flatgeobuf": _wfmt_flatgeobuf,

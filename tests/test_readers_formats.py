@@ -1303,3 +1303,35 @@ def test_osm_reader(tmp_path):
         t.geometry, 3, np.asarray([[-73.9995, 40.0005]])
     )
     assert bool(inside[0])
+
+
+def test_write_kml_round_trip(tmp_path):
+    import numpy as np
+
+    from mosaic_tpu.core.geometry import wkt
+    from mosaic_tpu.readers import read, write
+    from mosaic_tpu.readers.vector import VectorTable
+
+    col = wkt.from_wkt([
+        "POLYGON ((0 0, 5 0, 5 5, 0 5, 0 0), (1 1, 1 2, 2 2, 2 1, 1 1))",
+        "MULTIPOLYGON (((10 0, 12 0, 11 2, 10 0)), ((20 0, 22 0, 21 2, 20 0)))",
+        "LINESTRING (0 0, 2 3)",
+    ])
+    t = VectorTable(
+        geometry=col,
+        columns={
+            "nm": np.asarray(["a", "b", "c"], object),
+            "v": np.asarray([1.5, 2.5, 3.5]),
+        },
+    )
+    p = str(tmp_path / "x.kml")
+    write("kml").option("name_col", "nm").save(p, t)
+    r = read("kml").load(p)
+    assert len(r) == 3
+    w = wkt.to_wkt(r.geometry)
+    assert w[0].startswith("POLYGON") and "1 1" in w[0]  # hole survives
+    assert w[1].startswith("MULTIPOLYGON")
+    assert list(r.columns["name"]) == ["a", "b", "c"]
+    np.testing.assert_allclose(
+        np.asarray(r.columns["v"], float), [1.5, 2.5, 3.5]
+    )
