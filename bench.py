@@ -206,9 +206,12 @@ def _probe_once(timeout: float, rec: dict) -> str | None:
 def _probe_platform(detail: dict) -> str:
     """Decide tpu vs cpu WITHOUT risking a hang in this process.
 
-    Retries hung/erroring probes with exponential backoff inside a total
-    budget; the full attempt trail lands in ``detail["probe"]``.
+    Retries hung/erroring probes with exponential backoff (the shared
+    `mosaic_tpu.runtime.retry` schedule) inside a total budget; the full
+    attempt trail lands in ``detail["probe"]``.
     """
+    from mosaic_tpu.runtime.retry import RetryPolicy, backoff_delays
+
     trail: list[dict] = []
     detail["probe"] = trail
     forced = os.environ.get("MOSAIC_BENCH_PLATFORM")
@@ -218,7 +221,12 @@ def _probe_platform(detail: dict) -> str:
     per = float(os.environ.get("MOSAIC_BENCH_PROBE_TIMEOUT", "120"))
     budget = float(os.environ.get("MOSAIC_BENCH_PROBE_BUDGET", "480"))
     t_start = time.monotonic()
-    backoff = 15.0
+    delays = backoff_delays(
+        RetryPolicy(
+            max_attempts=1 << 30, base_delay_s=15.0, max_delay_s=120.0,
+            timeout_s=budget, jitter=0.25,
+        )
+    )
     attempt = 0
     while True:
         attempt += 1
@@ -227,13 +235,13 @@ def _probe_platform(detail: dict) -> str:
         verdict = _probe_once(per, rec)
         if verdict is not None:
             return verdict
+        backoff = next(delays)
         if time.monotonic() - t_start + backoff + per > budget:
             trail.append(
                 {"outcome": "budget_exhausted", "budget_s": budget}
             )
             return "cpu"
         time.sleep(backoff)
-        backoff = min(backoff * 2, 120.0)
 
 
 def _maybe_late_tpu_retry(obj: dict) -> dict:
@@ -865,11 +873,26 @@ def main():
         # (cell-level disagreement overstates it: a moved cell only flips
         # the answer when the point also sits near a zone boundary)
         if cell_dtype == jnp.float32:
+            from mosaic_tpu.runtime.retry import RetryPolicy, call_with_retry
+
             try:
+                # transient tunnel-compile failures (observed 2026-07-31:
+                # remote_compile HTTP 500 here zeroed a 34M pts/s TPU run)
+                # retry via the shared runtime policy before the lane is
+                # abandoned — a salvaged retry keeps the lane's numbers
                 c64 = np.asarray(
-                    jax.jit(
-                        lambda p: h3.point_to_cell(p, RES).astype(jnp.int64)
-                    )(jnp.asarray(sub, dtype=jnp.float64))
+                    call_with_retry(
+                        lambda: jax.jit(
+                            lambda p: h3.point_to_cell(p, RES).astype(
+                                jnp.int64
+                            )
+                        )(jnp.asarray(sub, dtype=jnp.float64)),
+                        policy=RetryPolicy(
+                            max_attempts=3, base_delay_s=2.0,
+                            max_delay_s=30.0, timeout_s=120.0,
+                        ),
+                        label="bench.agreement_lane",
+                    )
                 )
                 detail["cell_f32_f64_agreement"] = round(
                     float((pcells == c64).mean()), 6
@@ -881,10 +904,8 @@ def main():
                 detail["join_f32_f64_agreement"] = round(jagree, 6)
                 if jagree < 0.998:
                     detail["join_f32_f64_floor_violated"] = True
-            except Exception as e:  # transient tunnel-compile failures
-                # must not kill a bench whose headline already measured
-                # (observed 2026-07-31: remote_compile HTTP 500 here
-                # zeroed a 34M pts/s TPU run)
+            except Exception as e:  # non-transient: the headline already
+                # measured; record and keep the bench line
                 detail["agreement_error"] = repr(e)[:200]
 
         # epsilon-band borderline recheck lane (SURVEY §7, VERDICT r4 #3):

@@ -23,6 +23,13 @@ if not _os.environ.get("MOSAIC_TPU_NO_X64"):
 
 from .core.types import GeometryBuilder, GeometryType, PackedGeometry, PaddedGeometry
 from .context import MosaicConfig, MosaicContext, index_system_factory
+from .runtime.errors import (
+    CapacityOverflow,
+    DegradedResult,
+    MosaicRuntimeError,
+    RetryExhausted,
+    TransientDeviceError,
+)
 
 __version__ = "0.1.0"
 
@@ -34,12 +41,17 @@ def enable_mosaic(index_system="H3", geometry_backend="device", **kwargs):
 
 
 __all__ = [
+    "CapacityOverflow",
+    "DegradedResult",
     "GeometryBuilder",
     "GeometryType",
     "MosaicConfig",
     "MosaicContext",
+    "MosaicRuntimeError",
     "PackedGeometry",
     "PaddedGeometry",
+    "RetryExhausted",
+    "TransientDeviceError",
     "enable_mosaic",
     "index_system_factory",
     "__version__",

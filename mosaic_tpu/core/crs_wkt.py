@@ -293,10 +293,17 @@ def wkt_to_proj_string(text: str) -> str:
         params["lat_ts"] = params.pop("lat_1")
     if proj == "stere":
         # ESRI "Stereographic_North/South_Pole" carries the pole in
-        # standard_parallel_1's sign; OGC Polar_Stereographic in lat_0
-        if "lat_0" not in params or abs(params["lat_0"]) != 90.0:
+        # standard_parallel_1's sign; OGC Polar_Stereographic in lat_0.
+        # Parameter values are still in the CRS's angular unit here (the
+        # ``val *= ang_deg`` scaling below), so both the is-it-the-pole
+        # test and the injected pole must be expressed in that unit — a
+        # raw 90.0 in a grads .prj would scale to 81° and miss the pole.
+        if (
+            "lat_0" not in params
+            or abs(params["lat_0"] * ang_deg) != 90.0
+        ):
             ts = params.get("lat_ts", params.get("lat_0", 90.0))
-            params["lat_0"] = math.copysign(90.0, ts)
+            params["lat_0"] = math.copysign(90.0 / ang_deg, ts)
     if proj == "omerc":
         # omerc's center longitude rides +lonc
         if "lon_0" in params:

@@ -26,6 +26,9 @@ import numpy as np
 from ..core.index.base import IndexSystem
 from ..core.tessellate import tessellate
 from ..functions._coerce import to_packed
+from ..runtime import faults as _faults
+from ..runtime.errors import DegradedResult
+from ..runtime.retry import call_with_retry
 from .core import CheckpointManager
 
 
@@ -210,6 +213,7 @@ class SpatialKNN:
         prev_matches = 0
         w = self._cell_width(res)
         iterations = 0
+        degraded = False
 
         def matched(i: int) -> int:
             return int((cid[i] >= 0).sum())
@@ -251,7 +255,10 @@ class SpatialKNN:
                     ci_list.append(rr)
             li = np.asarray(li_list, dtype=np.int64)
             ci = np.asarray(ci_list, dtype=np.int64)
-            d = ring.pair_distances(dl, dc, li, ci)
+            d = _resilient_distances(ring, dl, dc, li, ci, land, cand)
+            if isinstance(d, DegradedResult):
+                degraded = True
+                d = np.asarray(d)
             if self.distance_threshold is not None:
                 keep = d <= self.distance_threshold
                 li, ci, d = li[keep], ci[keep], d[keep]
@@ -301,6 +308,9 @@ class SpatialKNN:
             ),
             "resolution": res,
             "approximate": self.approximate,
+            # True when any iteration's distances came from the f64 host
+            # oracle after the device path failed past its retry budget
+            "degraded": degraded,
         }
         if ckpt is not None:
             ckpt.write_meta(self.metrics)
@@ -315,6 +325,31 @@ class SpatialKNN:
     def get_metrics(self) -> dict:
         """Reference: `SpatialKNN.getMetrics:280-318` (MLflow loggables)."""
         return dict(self.metrics)
+
+
+def _resilient_distances(ring, dl, dc, li, ci, land, cand):
+    """Device pair distances with transient-failure retry; past the
+    budget the batch degrades to the exact f64 oracle `st_distance`
+    (flagged :class:`DegradedResult` — the model records it in metrics
+    rather than crashing mid-iteration or dropping pairs)."""
+    if not li.size:
+        return np.zeros(0)
+
+    def device_eval():
+        _faults.maybe_fail("knn.pair_distances")
+        return ring.pair_distances(dl, dc, li, ci)
+
+    def oracle_eval():
+        from ..functions.geometry import st_distance
+
+        return np.asarray(
+            st_distance(land.take(li), cand.take(ci), backend="oracle"),
+            dtype=np.float64,
+        )
+
+    return call_with_retry(
+        device_eval, label="knn.pair_distances", fallback=oracle_eval
+    )
 
 
 def _default_resolution(index: IndexSystem, col) -> int:

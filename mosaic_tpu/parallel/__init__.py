@@ -4,13 +4,21 @@ The reference distributes via Spark shuffle/broadcast (SURVEY.md §2.8). Here
 distribution is a `jax.sharding.Mesh` + `shard_map`: the point side shards
 over every device, the polygon chip index shards over one mesh axis and is
 all-gathered over ICI inside the step (the BASELINE.json north-star design),
-and aggregates ride `psum`.
+and aggregates ride `psum`. `dist_pip_join` is the managed entry point with
+the full resilience story (capacity escalation, transient retry, host-oracle
+degradation — `mosaic_tpu/runtime/`).
 """
 
 from .dist_join import (
+    dist_pip_join,
     distributed_join_step,
     make_mesh,
     pad_index_for_shards,
 )
 
-__all__ = ["make_mesh", "distributed_join_step", "pad_index_for_shards"]
+__all__ = [
+    "dist_pip_join",
+    "distributed_join_step",
+    "make_mesh",
+    "pad_index_for_shards",
+]

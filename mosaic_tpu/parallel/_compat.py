@@ -1,0 +1,21 @@
+"""Version compatibility shims for the distribution layer.
+
+`jax.shard_map` is the stable spelling from jax 0.6; earlier releases
+(this container ships 0.4.x) only expose
+`jax.experimental.shard_map.shard_map`. The graceful-degradation
+contract of the runtime layer extends to the toolchain: resolve
+whichever spelling exists instead of crashing every `parallel/` import
+site on older jax.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """`jax.shard_map` where available, else the experimental spelling."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:
+        from jax.experimental.shard_map import shard_map as sm
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)

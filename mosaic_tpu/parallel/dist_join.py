@@ -20,6 +20,7 @@ No shuffle, no host round-trip: one `shard_map`-ped XLA program per step.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -28,7 +29,19 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.geometry.device import DeviceGeometry
-from ..sql.join import ChipIndex, pip_join_points
+from ._compat import shard_map as _shard_map
+from ..runtime import faults as _faults, telemetry as _telemetry
+from ..runtime.errors import DegradedResult, RetryExhausted
+from ..runtime.escalate import run_escalating
+from ..runtime.retry import call_with_retry
+from ..sql.join import (
+    OVERFLOW,
+    ChipIndex,
+    HostRecheck,
+    host_join_with_cells,
+    pip_join_points,
+)
+from ..utils import get_logger
 
 _I64_MAX = np.iinfo(np.int64).max
 
@@ -241,7 +254,7 @@ def distributed_join_step(
         counts = lax.psum(counts, mesh.axis_names)
         return match, counts
 
-    sharded = jax.shard_map(
+    sharded = _shard_map(
         step,
         mesh=mesh,
         in_specs=(point_spec, point_spec, index_spec),
@@ -260,3 +273,106 @@ def pad_points(points: np.ndarray, cells: np.ndarray, multiple: int):
         np.pad(points, ((0, d), (0, 0))),
         np.pad(cells, (0, d), constant_values=-1),
     )
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_step(mesh, num_zones, table_size, found_cap, heavy_cap):
+    """One compiled step per (mesh, zones, layout, caps) — escalation
+    re-enters here with grown caps, so only distinct cap sets compile."""
+    return distributed_join_step(
+        mesh, num_zones, table_size=table_size,
+        found_cap=found_cap, heavy_cap=heavy_cap,
+    )
+
+
+def dist_pip_join(
+    points: np.ndarray,
+    pcells: np.ndarray,
+    index: ChipIndex,
+    mesh: Mesh,
+    num_zones: int,
+    *,
+    table_size: int | None = None,
+    found_cap: int | None = None,
+    heavy_cap: int | None = None,
+    host: HostRecheck | None = None,
+):
+    """Managed distributed join: the resilience-wrapped spelling of
+    `distributed_join_step` (the `dist_pip_join` of ISSUE/ROADMAP).
+
+    Takes RAW (unshifted) f64 ``points`` plus their precomputed cell ids;
+    owns the recenter shift, the shard padding, and the full failure
+    story:
+
+    - OVERFLOW rows (caps shrunk by `runtime.faults` injection, or
+      explicit per-shard ``found_cap``/``heavy_cap`` undersized) trigger
+      the bounded escalation engine — caps regrow geometrically until the
+      match column is exact, else typed ``CapacityOverflow``;
+    - transient device failures retry with backoff; past the budget the
+      call degrades to the exact f64 host oracle (``host`` defaults to
+      the index's companion) and the match column comes back flagged
+      :class:`DegradedResult` — never silent ``-2``/zeroed output.
+
+    Returns ``(match, zone_counts)``: (N,) int32 matched row per point
+    and the (num_zones,) int64 per-zone histogram.
+    """
+    host = host if host is not None else getattr(index, "host", None)
+    raw = np.asarray(points, dtype=np.float64)
+    pc = np.asarray(pcells)
+    n = raw.shape[0]
+    shift = (
+        host.shift
+        if host is not None
+        else np.asarray(index.border.shift, dtype=np.float64)
+    )
+    dtype = np.asarray(index.border.verts).dtype
+    padded_index = pad_index_for_shards(index, int(mesh.shape["cell"]))
+    p, c = pad_points((raw - shift).astype(dtype), pc, mesh.size)
+    per_shard = p.shape[0] // mesh.size
+    caps = _faults.clamp_caps(
+        {"found_cap": found_cap, "heavy_cap": heavy_cap}
+    )
+    grow = {k: v for k, v in caps.items() if v is not None}
+    ceilings = {k: per_shard for k in grow}
+    pj, cj = jnp.asarray(p), jnp.asarray(c)
+
+    def attempt(capset):
+        _faults.maybe_fail("dist_join.step")
+        step = _cached_step(
+            mesh, num_zones, table_size,
+            capset.get("found_cap"), capset.get("heavy_cap"),
+        )
+        match, counts = step(pj, cj, padded_index)
+        return np.asarray(match)[:n], np.asarray(counts)
+
+    try:
+        (match, counts), _ = run_escalating(
+            lambda cc: call_with_retry(attempt, cc, label="dist_join.step"),
+            grow, ceilings,
+            overflow_count=lambda r: int((r[0] == OVERFLOW).sum()),
+            stage="dist_pip_join",
+        )
+        return match, counts
+    except RetryExhausted as e:
+        if host is None:
+            raise
+        _telemetry.record(
+            "degraded", label="dist_pip_join", attempts=e.attempts,
+            error=repr(e.last)[:200],
+        )
+        get_logger("mosaic_tpu.runtime").warning(
+            "dist_pip_join: device path failed %d times (%r); answering "
+            "from the f64 host oracle", e.attempts, e.last,
+        )
+        hmatch = host_join_with_cells(raw, pc, host)
+        hcounts = np.bincount(
+            hmatch[hmatch >= 0], minlength=num_zones
+        )[:num_zones].astype(np.int64)
+        return (
+            DegradedResult.wrap(
+                hmatch,
+                reason=f"dist_pip_join retries exhausted ({e.last!r})"[:300],
+                attempts=e.attempts,
+            ),
+            hcounts,
+        )

@@ -21,6 +21,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.geometry.device import DeviceGeometry, take_rows
+from ._compat import shard_map as _shard_map
 from .dist_overlay import geom_specs
 
 
@@ -40,7 +41,7 @@ def _sharded_distance_fn(mesh: Mesh):
         )
 
     return jax.jit(
-        jax.shard_map(
+        _shard_map(
             step, mesh=mesh, in_specs=(rep, rep, row, row), out_specs=row
         )
     )

@@ -240,6 +240,12 @@ def write_kml(path: str, table, name_col: "str | None" = None) -> None:
             .replace(">", "&gt;")
         )
 
+    def esc_attr(s):
+        # attribute values additionally need quote escaping (the
+        # xml.sax.saxutils.quoteattr contract): a column name carrying
+        # '"' would otherwise terminate the name="..." attribute early
+        return esc(s).replace('"', "&quot;").replace("'", "&apos;")
+
     rows = []
     for g in range(len(col)):
         nm = (
@@ -248,7 +254,7 @@ def write_kml(path: str, table, name_col: "str | None" = None) -> None:
             else ""
         )
         data = "".join(
-            f'<Data name="{esc(k)}"><value>{esc(v[g])}</value></Data>'
+            f'<Data name="{esc_attr(k)}"><value>{esc(v[g])}</value></Data>'
             for k, v in table.columns.items()
             if k != name_col
         )

@@ -32,7 +32,7 @@ from .vector import VectorTable, props_to_columns
 #: closed ways with any of these tag keys become polygons
 _AREA_KEYS = {
     "building", "landuse", "natural", "leisure", "amenity", "area",
-    "shop", "tourism", "waterway" "place",
+    "shop", "tourism", "waterway", "place",
 }
 
 

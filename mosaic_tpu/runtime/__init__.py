@@ -1,0 +1,49 @@
+"""Runtime resilience layer: typed errors, capacity escalation, transient
+retry, graceful degradation, and fault injection.
+
+Reference analog: the reference system leans on Spark's executor retry and
+`try_sql` for failure containment (SURVEY §5); a TPU runtime has no executor
+to respawn, so resilience is explicit policy objects around the device
+entry points instead:
+
+- :mod:`errors`     — the typed taxonomy (`CapacityOverflow`,
+  `TransientDeviceError`, `RetryExhausted`, `DegradedResult`) that replaces
+  bare ``Exception`` catches and raw ``-2`` sentinels at API boundaries;
+- :mod:`escalate`   — the bounded geometric cap-growth loop that turns an
+  OVERFLOW-capable device call into an exact-or-typed-error contract;
+- :mod:`retry`      — bounded transient-failure retry with exponential
+  backoff + jitter and an optional host-oracle fallback (degradation);
+- :mod:`telemetry`  — structured events every escalation/retry/degradation
+  emits (capturable in tests, logged via `utils.get_logger`);
+- :mod:`faults`     — context-manager fault injection (shrunken caps,
+  synthetic transient errors) exercising all of the above for real.
+"""
+
+from .errors import (
+    CapacityOverflow,
+    DegradedResult,
+    MosaicRuntimeError,
+    RetryExhausted,
+    TransientDeviceError,
+    is_transient,
+)
+from .escalate import EscalationPolicy, run_escalating
+from .retry import RetryPolicy, backoff_delays, call_with_retry, with_retry
+from . import faults, telemetry
+
+__all__ = [
+    "CapacityOverflow",
+    "DegradedResult",
+    "EscalationPolicy",
+    "MosaicRuntimeError",
+    "RetryExhausted",
+    "RetryPolicy",
+    "TransientDeviceError",
+    "backoff_delays",
+    "call_with_retry",
+    "faults",
+    "is_transient",
+    "run_escalating",
+    "telemetry",
+    "with_retry",
+]

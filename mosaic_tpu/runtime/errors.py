@@ -1,0 +1,128 @@
+"""Typed error taxonomy for the runtime resilience layer.
+
+Every failure a device join path can hit maps to one of three classes —
+capacity (the bounded-shape contract overflowed), transient (the device,
+tunnel, or remote compiler hiccuped and the same call may succeed), and
+degraded (the device path was abandoned and the f64 host oracle answered
+instead). API boundaries raise these instead of returning raw ``-2``
+sentinel rows or letting bare ``Exception``\\ s escape.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class MosaicRuntimeError(RuntimeError):
+    """Base of every typed runtime-resilience error."""
+
+
+class CapacityOverflow(MosaicRuntimeError):
+    """A bounded-capacity device path overflowed and escalation could not
+    (or was not allowed to) grow the caps to an exact answer.
+
+    Carries the escalation trail so callers/telemetry can see every
+    attempted cap set; ``overflow_count`` is the number of rows whose
+    answer was still unknown at the last attempt.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        stage: str = "",
+        caps: dict | None = None,
+        attempts: int = 0,
+        overflow_count: int = 0,
+    ):
+        super().__init__(message)
+        self.stage = stage
+        self.caps = dict(caps or {})
+        self.attempts = attempts
+        self.overflow_count = overflow_count
+
+
+class TransientDeviceError(MosaicRuntimeError):
+    """A device/tunnel/remote-compile failure that may succeed on retry
+    (the class fault injection raises synthetically)."""
+
+    def __init__(self, message: str, *, site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+
+class RetryExhausted(MosaicRuntimeError):
+    """The bounded transient-retry budget ran out without a success.
+
+    ``last`` is the final underlying exception; ``attempts`` how many
+    tries were made.
+    """
+
+    def __init__(self, message: str, *, attempts: int = 0, last=None):
+        super().__init__(message)
+        self.attempts = attempts
+        self.last = last
+
+
+#: substrings that mark an exception as transient (observed in the wild:
+#: remote-compile HTTP 500s and tunnel drops on the axon rig, round 2/5;
+#: matched case-insensitively against ``repr(exc)``)
+_TRANSIENT_MARKERS = (
+    "http 500",
+    "http error 500",
+    "remote_compile",
+    "remote compile",
+    "unavailable",
+    "deadline exceeded",
+    "deadline_exceeded",
+    "socket closed",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "tunnel",
+    "internal: ",
+)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Should this exception be retried?  `TransientDeviceError` always;
+    other exceptions only when their text carries a known transient
+    marker (programming errors like ValueError/TypeError never are)."""
+    if isinstance(exc, TransientDeviceError):
+        return True
+    if isinstance(exc, (ValueError, TypeError, KeyError, AttributeError)):
+        return False
+    text = repr(exc).lower()
+    return any(m in text for m in _TRANSIENT_MARKERS)
+
+
+class DegradedResult(np.ndarray):
+    """An ndarray view flagging a graceful-degradation result.
+
+    Returned (instead of a plain array) when the device path failed past
+    its retry budget and the f64 host oracle answered instead: values are
+    exact, but the call did not run on the fast path. Behaves exactly
+    like its base array everywhere else, so existing callers keep
+    working; resilience-aware callers check ``getattr(r, "degraded",
+    False)``.
+    """
+
+    degraded: bool = True
+
+    @classmethod
+    def wrap(
+        cls, value, *, reason: str = "", attempts: int = 0,
+        detail: dict | None = None,
+    ) -> "DegradedResult":
+        out = np.asarray(value).view(cls)
+        out.reason = reason
+        out.attempts = attempts
+        out.detail = dict(detail or {})
+        return out
+
+    def __array_finalize__(self, obj):
+        if obj is None:
+            return
+        self.reason = getattr(obj, "reason", "")
+        self.attempts = getattr(obj, "attempts", 0)
+        self.detail = getattr(obj, "detail", {})

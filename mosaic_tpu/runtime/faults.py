@@ -1,0 +1,148 @@
+"""Fault injection: force the failure modes the resilience layer handles.
+
+Context managers install a thread-local fault plan that the instrumented
+device entry points consult (`pip_join`, `dist_pip_join`,
+`overlay_join`'s predicate, `SpatialKNN`'s distance step):
+
+- :func:`shrink_caps` clamps the exactly-sized compaction caps down, so
+  the next join genuinely overflows tier 1/2 and must escalate back to
+  exactness (:func:`force_tier2_overflow` is the tier-2 spelling);
+- :func:`transient_errors` raises a synthetic
+  :class:`TransientDeviceError` on the first N guarded calls, modelling
+  the remote-compile HTTP 500s observed on the axon tunnel;
+- :func:`inject` composes both.
+
+With no plan installed every hook is a near-free no-op (one thread-local
+attribute read), so production paths pay nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import fnmatch
+import threading
+
+from . import telemetry
+from .errors import TransientDeviceError
+
+_LOCAL = threading.local()
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One active injection: cap clamps + synthetic transient failures."""
+
+    cap_clamps: dict[str, int] = dataclasses.field(default_factory=dict)
+    fail_first: int = 0
+    sites: tuple[str, ...] = ("*",)
+    exc_factory: "Callable[[str], BaseException] | None" = None
+    #: mutable counters: guarded calls failed so far / trail of trip sites
+    failed: int = 0
+    trips: list = dataclasses.field(default_factory=list)
+
+    def matches(self, site: str) -> bool:
+        return any(fnmatch.fnmatch(site, pat) for pat in self.sites)
+
+
+def _plans() -> list[FaultPlan]:
+    plans = getattr(_LOCAL, "plans", None)
+    if plans is None:
+        plans = _LOCAL.plans = []
+    return plans
+
+
+def active() -> bool:
+    """Is any fault plan installed on this thread?"""
+    return bool(getattr(_LOCAL, "plans", None))
+
+
+@contextlib.contextmanager
+def inject(
+    *,
+    shrink_caps: dict[str, int] | None = None,
+    fail_first: int = 0,
+    sites: tuple[str, ...] = ("*",),
+    exc_factory: "Callable[[str], BaseException] | None" = None,
+):
+    """Install a fault plan for the block; yields it (``plan.trips``
+    records every synthetic failure actually raised)."""
+    plan = FaultPlan(
+        cap_clamps=dict(shrink_caps or {}),
+        fail_first=int(fail_first),
+        sites=tuple(sites),
+        exc_factory=exc_factory,
+    )
+    _plans().append(plan)
+    try:
+        yield plan
+    finally:
+        _plans().remove(plan)
+
+
+def shrink_caps(**caps: int):
+    """Clamp named capacity knobs at their next sizing — e.g.
+    ``shrink_caps(found_cap=8, heavy_cap=8)`` forces both compaction
+    tiers to overflow on realistic inputs."""
+    return inject(shrink_caps=caps)
+
+
+def force_tier2_overflow(heavy_cap: int = 8, **more: int):
+    """Force the tier-2 (heavy-cell) compaction to overflow by clamping
+    ``heavy_cap`` (and any additional named caps) at sizing time."""
+    return inject(shrink_caps={"heavy_cap": heavy_cap, **more})
+
+
+def transient_errors(
+    n: int = 2,
+    sites: tuple[str, ...] = ("*",),
+    exc_factory: "Callable[[str], BaseException] | None" = None,
+):
+    """Raise a synthetic transient error on the first ``n`` guarded calls
+    matching ``sites`` (fnmatch patterns over hook names like
+    ``"pip_join.device"``)."""
+    return inject(fail_first=n, sites=sites, exc_factory=exc_factory)
+
+
+def maybe_fail(site: str) -> None:
+    """Hook: raise the planned synthetic fault for ``site``, if any.
+
+    Placed at the top of each guarded device attempt so the retry layer
+    sees the failure exactly where a real tunnel/compile error surfaces.
+    """
+    for plan in _plans():
+        if plan.fail_first and plan.failed < plan.fail_first and plan.matches(site):
+            plan.failed += 1
+            plan.trips.append(site)
+            telemetry.record(
+                "fault_injected", site=site, n=plan.failed,
+                of=plan.fail_first,
+            )
+            if plan.exc_factory is not None:
+                raise plan.exc_factory(site)
+            raise TransientDeviceError(
+                f"injected transient device error at {site} "
+                f"({plan.failed}/{plan.fail_first})",
+                site=site,
+            )
+
+
+def clamp_caps(caps: dict) -> dict:
+    """Apply every active plan's cap clamps to a cap dict.
+
+    ``None`` entries (meaning "exact/unbounded") are replaced by the
+    injected clamp; numeric entries are min-clamped. Without an active
+    plan the dict is returned unchanged.
+    """
+    if not active():
+        return caps
+    out = dict(caps)
+    for plan in _plans():
+        for k, v in plan.cap_clamps.items():
+            if k in out:
+                out[k] = int(v) if out[k] is None else min(int(out[k]), int(v))
+    if out != caps:
+        telemetry.record("caps_clamped", caps={
+            k: out[k] for k in out if out[k] != caps.get(k)
+        })
+    return out

@@ -1,0 +1,53 @@
+"""Structured resilience telemetry.
+
+Every escalation attempt, transient retry, fault injection, and
+degradation emits one flat event dict here. Events always go to the
+`mosaic_tpu.runtime` logger; tests and services additionally subscribe
+with :func:`capture` to assert on (or export) the exact trail — the
+acceptance contract is that resilience is *visible*, never silent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..utils import get_logger
+
+_LOCAL = threading.local()
+
+
+def _sinks() -> list:
+    sinks = getattr(_LOCAL, "sinks", None)
+    if sinks is None:
+        sinks = _LOCAL.sinks = []
+    return sinks
+
+
+def record(event: str, **fields) -> dict:
+    """Emit one structured event: ``{"event": event, **fields}``.
+
+    Fields must be plain JSON-able scalars/dicts so trails can be dumped
+    into bench lines verbatim.
+    """
+    evt = {"event": event, **fields}
+    for sink in _sinks():
+        sink.append(evt)
+    get_logger("mosaic_tpu.runtime").info("%s %s", event, fields)
+    return evt
+
+
+@contextlib.contextmanager
+def capture():
+    """Collect every resilience event emitted in the block (thread-local).
+
+    >>> with telemetry.capture() as events:
+    ...     pip_join(...)
+    >>> [e for e in events if e["event"] == "capacity_overflow"]
+    """
+    events: list[dict] = []
+    _sinks().append(events)
+    try:
+        yield events
+    finally:
+        _sinks().remove(events)

@@ -1,5 +1,13 @@
 """High-level services (reference analog: `src/main/scala/.../sql/`)."""
 
 from .join import ChipIndex, build_chip_index, pip_join, pip_join_points
+from .overlay import intersects_join, overlay_join
 
-__all__ = ["ChipIndex", "build_chip_index", "pip_join", "pip_join_points"]
+__all__ = [
+    "ChipIndex",
+    "build_chip_index",
+    "intersects_join",
+    "overlay_join",
+    "pip_join",
+    "pip_join_points",
+]

@@ -49,6 +49,11 @@ from ..core.geometry.device import (
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
+from ..runtime import faults as _faults, telemetry as _telemetry
+from ..runtime.errors import DegradedResult, RetryExhausted
+from ..runtime.escalate import run_escalating
+from ..runtime.retry import call_with_retry
+from ..utils import get_logger
 
 _SENTINEL = jnp.iinfo(jnp.int32).max
 _I32_MAX = np.iinfo(np.int32).max
@@ -1064,6 +1069,11 @@ def pip_join_points(
         raise ValueError(
             f"compaction must be scatter|mxu, got {compaction!r}"
         )
+    if compact_block % 128:
+        raise ValueError(
+            f"compact_block must be a multiple of 128 (TPU lane width), "
+            f"got {compact_block}"
+        )
     if lookup != "gather" and (
         writeback == "direct" or index.cell_edges.dtype != jnp.float32
     ):
@@ -1284,7 +1294,15 @@ def pip_join(
     row per point (-1 = no polygon). ``batch_size`` chunks the point axis
     to bound the probe intermediates. Compaction caps are sized exactly
     from two device-side scalar counts (no cell column ever crosses back
-    to the host), so no point can overflow.
+    to the host), so no point can overflow. Should a cap overflow anyway
+    (shrunken by `runtime.faults` injection, or user-adversarial inputs),
+    the bounded escalation engine (`runtime/escalate.py`) regrows every
+    cap geometrically until the answer is exact or raises a typed
+    :class:`~mosaic_tpu.runtime.CapacityOverflow` — :data:`OVERFLOW`
+    rows never escape this API. Transient device failures retry with
+    backoff (`runtime/retry.py`); past the budget the call degrades to
+    the exact f64 host oracle and the result is flagged
+    :class:`~mosaic_tpu.runtime.DegradedResult`.
 
     ``recheck`` (default: the ``exact_recheck`` config flag) switches on
     the epsilon-band borderline recheck — the SURVEY §7 precision
@@ -1367,6 +1385,8 @@ def pip_join(
                 if chip_index.num_heavy_cells
                 else None
             )
+            caps = _faults.clamp_caps({"heavy_cap": hcap})
+            hcap = caps["heavy_cap"]
         else:
             nf, nh = (
                 int(v) for v in np.asarray(_JIT_COUNTS(cells, chip_index))
@@ -1377,15 +1397,37 @@ def pip_join(
                 if chip_index.num_heavy_cells
                 else None
             )
-        shifted = jnp.asarray(chunk - shift, dtype=dtype)
-        if not recheck:
-            return np.asarray(
-                _JIT_JOIN(
-                    shifted, cells, chip_index,
-                    heavy_cap=hcap, found_cap=fcap, writeback=writeback,
-                    lookup=lookup,
-                )
+            # fault injection may clamp the exactly-sized caps (no-op
+            # without an active plan); the escalation loop grows them back
+            caps = _faults.clamp_caps(
+                {"found_cap": fcap, "heavy_cap": hcap}
             )
+            fcap, hcap = caps["found_cap"], caps["heavy_cap"]
+        shifted = jnp.asarray(chunk - shift, dtype=dtype)
+        # every cap that exists escalates together toward the row-count
+        # ceiling, at which overflow is structurally impossible
+        grow = {k: v for k, v in caps.items() if v is not None}
+        ceilings = {k: chunk.shape[0] for k in grow}
+        if not recheck:
+
+            def attempt(c):
+                _faults.maybe_fail("pip_join.device")
+                return np.asarray(
+                    _JIT_JOIN(
+                        shifted, cells, chip_index,
+                        heavy_cap=c.get("heavy_cap", hcap),
+                        found_cap=c.get("found_cap", fcap),
+                        writeback=writeback, lookup=lookup,
+                    )
+                )
+
+            out, _ = run_escalating(
+                lambda c: call_with_retry(attempt, c, label="pip_join.device"),
+                grow, ceilings,
+                overflow_count=lambda o: int((o == OVERFLOW).sum()),
+                stage="pip_join",
+            )
+            return out
 
         # --- epsilon-band recheck (SURVEY §7) -------------------------
         eps2 = jnp.asarray(
@@ -1393,13 +1435,26 @@ def pip_join(
              * host.coord_scale) ** 2,
             dtype=dtype,
         )
-        out_dev, near = _JIT_JOIN(
-            shifted, cells, chip_index,
-            heavy_cap=hcap, found_cap=fcap, edge_eps2=eps2,
-            writeback=writeback, lookup=lookup,
+
+        def attempt_banded(c):
+            _faults.maybe_fail("pip_join.device")
+            o, nr = _JIT_JOIN(
+                shifted, cells, chip_index,
+                heavy_cap=c.get("heavy_cap", hcap),
+                found_cap=c.get("found_cap", fcap), edge_eps2=eps2,
+                writeback=writeback, lookup=lookup,
+            )
+            return np.array(o), np.array(nr)  # writable host copies
+
+        (out, host_mask), _ = run_escalating(
+            lambda c: call_with_retry(
+                attempt_banded, c, label="pip_join.device"
+            ),
+            grow, ceilings,
+            overflow_count=lambda r: int((r[0] == OVERFLOW).sum()),
+            stage="pip_join.recheck",
         )
-        out = np.array(out_dev)  # writable host copies
-        host_mask = np.array(near)  # PIP-boundary band -> host
+        # PIP-boundary band -> host (host_mask)
         if margins is not None:
             meps = float(np.finfo(np.dtype(margins.dtype)).eps)
             km = CELL_MARGIN_K * meps
@@ -1435,9 +1490,43 @@ def pip_join(
             out[rows] = host_join(chunk[rows], host, index_system, resolution)
         return out
 
+    def run_resilient(chunk: np.ndarray) -> np.ndarray:
+        """`run`, degrading to the exact f64 host oracle when the device
+        path fails past the transient-retry budget (result flagged
+        :class:`DegradedResult` — never a silent zero/wrong answer)."""
+        try:
+            return run(chunk)
+        except RetryExhausted as e:
+            if host is None:
+                raise
+            _telemetry.record(
+                "degraded", label="pip_join", attempts=e.attempts,
+                error=repr(e.last)[:200],
+            )
+            get_logger("mosaic_tpu.runtime").warning(
+                "pip_join: device path failed %d times (%r); answering "
+                "from the f64 host oracle", e.attempts, e.last,
+            )
+            return DegradedResult.wrap(
+                host_join(chunk, host, index_system, resolution),
+                reason=f"pip_join device retries exhausted ({e.last!r})"[:300],
+                attempts=e.attempts,
+            )
+
     if batch_size is None or n <= batch_size:
-        return run(raw)
+        return run_resilient(raw)
     out = np.empty(n, dtype=np.int32)
+    degraded: list[DegradedResult] = []
     for s in range(0, n, batch_size):
-        out[s : s + batch_size] = run(raw[s : s + batch_size])
+        r = run_resilient(raw[s : s + batch_size])
+        if isinstance(r, DegradedResult):
+            degraded.append(r)
+        out[s : s + batch_size] = r
+    if degraded:
+        return DegradedResult.wrap(
+            out,
+            reason=degraded[0].reason,
+            attempts=max(d.attempts for d in degraded),
+            detail={"degraded_batches": len(degraded)},
+        )
     return out

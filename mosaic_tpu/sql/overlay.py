@@ -21,6 +21,9 @@ import numpy as np
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
+from ..runtime import faults as _faults
+from ..runtime.errors import DegradedResult
+from ..runtime.retry import call_with_retry
 
 
 def _group_spans(cells_sorted: np.ndarray):
@@ -122,6 +125,7 @@ def intersects_join(
     decided = np.zeros(uniq_pairs.shape[0], bool)
     decided[pair_id[sure]] = True
     need = np.nonzero(~sure & ~decided[pair_id])[0]
+    degraded: DegradedResult | None = None
     if need.shape[0]:
         from ..functions.geometry import st_intersects
 
@@ -129,5 +133,34 @@ def intersects_join(
         # pair intersects iff ANY of its shared-cell chip pairs does
         a = lt.chips.take(lrows[need])
         b = rt.chips.take(rrows[need])
-        hit[need] = np.asarray(st_intersects(a, b, backend=backend))
-    return uniq_pairs[np.unique(pair_id[hit])]
+
+        def predicate():
+            _faults.maybe_fail("overlay.predicate")
+            return np.asarray(st_intersects(a, b, backend=backend))
+
+        # transient device failures retry with backoff; past the budget a
+        # non-oracle backend degrades to the exact f64 host oracle (result
+        # flagged), an oracle run raises typed RetryExhausted
+        res = call_with_retry(
+            predicate,
+            label="overlay.predicate",
+            fallback=(
+                (lambda: np.asarray(st_intersects(a, b, backend="oracle")))
+                if backend != "oracle"
+                else None
+            ),
+        )
+        if isinstance(res, DegradedResult):
+            degraded = res
+        hit[need] = np.asarray(res)
+    pairs = uniq_pairs[np.unique(pair_id[hit])]
+    if degraded is not None:
+        return DegradedResult.wrap(
+            pairs, reason=degraded.reason, attempts=degraded.attempts,
+        )
+    return pairs
+
+
+#: the managed overlay entry point under its workload name (the BNG
+#: overlay notebook's join) — same callable, resilience included
+overlay_join = intersects_join

@@ -113,3 +113,45 @@ def test_prj_sidecar_drives_shapefile_srid(tmp_path):
     write_shapefile(str(p), t, srid=27700)
     r = read_shapefile(str(p))
     assert int(r.geometry.srid[0]) == 27700
+
+
+GRADS_POLAR = (
+    'PROJCS["South Pole Stereo (grads)",GEOGCS["GCS_Sphere_Grads",'
+    'DATUM["D_Sphere",SPHEROID["Sphere",6371000.0,0.0]],'
+    'PRIMEM["Greenwich",0.0],UNIT["Grad",0.015707963267948967]],'
+    'PROJECTION["Polar_Stereographic"],'
+    'PARAMETER["Central_Meridian",0.0],'
+    'PARAMETER["Standard_Parallel_1",-80.0],'
+    'PARAMETER["False_Easting",0.0],PARAMETER["False_Northing",0.0],'
+    'UNIT["Metre",1.0]]'
+)
+
+
+def test_polar_stereographic_pole_in_grads_units():
+    """The injected pole must be expressed in the CRS's angular unit
+    BEFORE the unit scaling: a raw 90.0 in a grads .prj used to scale to
+    81 deg and place the projection center off the pole."""
+    s = wkt_to_proj_string(GRADS_POLAR)
+    assert "+proj=stere" in s
+    params = dict(
+        p[1:].split("=") for p in s.split() if p.startswith("+") and "=" in p
+    )
+    # lat_0 lands at the true pole in degrees (-80 grads -> south)
+    np.testing.assert_allclose(float(params["lat_0"]), -90.0, atol=1e-12)
+    # the standard parallel scales grads -> degrees: -80 grads = -72 deg
+    np.testing.assert_allclose(float(params["lat_ts"]), -72.0, atol=1e-9)
+
+
+def test_polar_stereographic_degree_pole_unchanged():
+    """Degree-unit .prj keeps the existing behavior (regression guard)."""
+    deg = GRADS_POLAR.replace(
+        'UNIT["Grad",0.015707963267948967]',
+        'UNIT["Degree",0.0174532925199433]',
+    ).replace('PARAMETER["Standard_Parallel_1",-80.0]',
+              'PARAMETER["Standard_Parallel_1",-71.0]')
+    s = wkt_to_proj_string(deg)
+    params = dict(
+        p[1:].split("=") for p in s.split() if p.startswith("+") and "=" in p
+    )
+    np.testing.assert_allclose(float(params["lat_0"]), -90.0, atol=1e-12)
+    np.testing.assert_allclose(float(params["lat_ts"]), -71.0, atol=1e-12)

@@ -1335,3 +1335,48 @@ def test_write_kml_round_trip(tmp_path):
     np.testing.assert_allclose(
         np.asarray(r.columns["v"], float), [1.5, 2.5, 3.5]
     )
+
+
+def test_osm_closed_waterway_and_place_are_polygons(tmp_path):
+    """`waterway` and `place` are SEPARATE area keys: the seed's missing
+    comma concatenated them into one bogus "waterwayplace" key, so a
+    closed riverbank way came back as a line (ADVICE.md)."""
+    from mosaic_tpu.readers import read
+
+    osm = """<?xml version='1.0'?>
+<osm version="0.6">
+ <node id="1" lat="40.0" lon="-74.0"/>
+ <node id="2" lat="40.001" lon="-74.0"/>
+ <node id="3" lat="40.001" lon="-73.999"/>
+ <node id="4" lat="40.0" lon="-73.999"/>
+ <way id="10"><nd ref="1"/><nd ref="2"/><nd ref="3"/><nd ref="4"/>
+   <nd ref="1"/><tag k="waterway" v="riverbank"/></way>
+ <way id="11"><nd ref="1"/><nd ref="2"/><nd ref="3"/><nd ref="4"/>
+   <nd ref="1"/><tag k="place" v="island"/></way>
+</osm>"""
+    p = tmp_path / "water.osm"
+    p.write_text(osm)
+    t = read("osm").load(str(p))
+    assert list(t.columns["kind"]) == ["polygon", "polygon"]
+
+
+def test_write_kml_quoted_attribute_round_trip(tmp_path):
+    """Column names land in Data name="..." attributes: quotes must be
+    escaped quoteattr-style or the attribute terminates early."""
+    import numpy as np
+
+    from mosaic_tpu.core.geometry import wkt
+    from mosaic_tpu.readers.kml import read_kml, write_kml
+    from mosaic_tpu.readers.vector import VectorTable
+
+    col = wkt.from_wkt(["POINT (1 2)", "POINT (3 4)"])
+    quoted = 'he said "hi" & <ok>\'s'
+    t = VectorTable(
+        geometry=col,
+        columns={quoted: np.asarray(["a\"b", "c'd"], object)},
+    )
+    p = str(tmp_path / "q.kml")
+    write_kml(p, t)
+    r = read_kml(p)
+    assert quoted in r.columns
+    assert list(r.columns[quoted]) == ['a"b', "c'd"]
