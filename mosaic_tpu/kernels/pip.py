@@ -210,29 +210,36 @@ def pip_zone(
     kernel = functools.partial(
         _pip_zone_kernel, tile_e=tile_e, tile_g=tile_g, n_real_g=int(n_real_g)
     )
-    out = pl.pallas_call(
-        kernel,
-        grid=(n_blocks, n_g, n_e),
-        in_specs=[
-            pl.BlockSpec(
-                (tile_n, 1), lambda i, g, e: (i, _I0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (tile_n, 1), lambda i, g, e: (i, _I0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (4, tile_e, tile_g),
-                lambda i, g, e: (_I0, e, g),
+    # named scope: the streaming pipeline's per-stage accounting extends
+    # into traces — xprof groups this lane's ops under one label so the
+    # kernel's share of a fused step is attributable (tools/trace_join.py)
+    with jax.named_scope("pip_zone.pallas"):
+        out = pl.pallas_call(
+            kernel,
+            grid=(n_blocks, n_g, n_e),
+            in_specs=[
+                pl.BlockSpec(
+                    (tile_n, 1), lambda i, g, e: (i, _I0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (tile_n, 1), lambda i, g, e: (i, _I0),
+                    memory_space=pltpu.VMEM,
+                ),
+                pl.BlockSpec(
+                    (4, tile_e, tile_g),
+                    lambda i, g, e: (_I0, e, g),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (tile_n, 1), lambda i, g, e: (i, _I0),
                 memory_space=pltpu.VMEM,
             ),
-        ],
-        out_specs=pl.BlockSpec(
-            (tile_n, 1), lambda i, g, e: (i, _I0), memory_space=pltpu.VMEM
-        ),
-        out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
-        scratch_shapes=[pltpu.VMEM((tile_n, tile_g), jnp.int32)],
-        interpret=interpret,
-    )(px, py, planes)
+            out_shape=jax.ShapeDtypeStruct((n_pad, 1), jnp.int32),
+            scratch_shapes=[pltpu.VMEM((tile_n, tile_g), jnp.int32)],
+            interpret=interpret,
+        )(px, py, planes)
     out = out.reshape(-1)[:N]
     return jnp.where(out >= _SENT, -1, out)
 

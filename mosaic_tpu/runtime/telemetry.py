@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 
 from ..utils import get_logger
 
@@ -35,6 +36,26 @@ def record(event: str, **fields) -> dict:
         sink.append(evt)
     get_logger("mosaic_tpu.runtime").info("%s %s", event, fields)
     return evt
+
+
+@contextlib.contextmanager
+def timed(event: str, **fields):
+    """Record ``event`` with a measured ``seconds`` field around the block.
+
+    The streaming pipeline's per-stage accounting contract: every stage
+    (ring build, compile, join loop, generator loop, narrow recheck)
+    emits exactly one event whose ``seconds`` is non-negative wall time —
+    benches embed the captured trail verbatim in their JSON artifacts.
+    """
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record(
+            event,
+            seconds=round(max(time.perf_counter() - t0, 0.0), 6),
+            **fields,
+        )
 
 
 @contextlib.contextmanager

@@ -2,12 +2,26 @@
 
 from .join import ChipIndex, build_chip_index, pip_join, pip_join_points
 from .overlay import intersects_join, overlay_join
+from .stream import (
+    StreamJoin,
+    StreamResult,
+    generator_rate,
+    hbm_peak,
+    ring_from_generator,
+    ring_from_host,
+)
 
 __all__ = [
     "ChipIndex",
+    "StreamJoin",
+    "StreamResult",
     "build_chip_index",
+    "generator_rate",
+    "hbm_peak",
     "intersects_join",
     "overlay_join",
     "pip_join",
     "pip_join_points",
+    "ring_from_generator",
+    "ring_from_host",
 ]

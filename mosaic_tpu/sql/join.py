@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 
 import jax
 import jax.numpy as jnp
@@ -970,6 +971,17 @@ def _heavy_tier(
 
     Returns (best2 (out_len,), over2 (out_len,) overflow mask,
     near2 (out_len,) | None when ``eps2`` is None)."""
+    with jax.named_scope("pip.tier2"):
+        return _heavy_tier_impl(
+            px, py, hs, index, heavy_cap, k2_default, out_len, eps2,
+            lookup, compaction, compact_block,
+        )
+
+
+def _heavy_tier_impl(
+    px, py, hs, index, heavy_cap, k2_default, out_len, eps2,
+    lookup, compaction, compact_block,
+):
     K2 = int(heavy_cap) if heavy_cap else k2_default
     K2 = max(8, min(K2, k2_default))
     if compaction == "mxu" and hs.shape[0] >= (1 << 16):
@@ -1081,7 +1093,10 @@ def pip_join_points(
         # fit), and the 3-term bf16 split is exact only for f32 tables
         lookup = "gather"
     N = points.shape[0]
-    u = _probe_slot(pcells, index)
+    # named scopes mark the probe stages in traces so the streaming
+    # pipeline's overlap (cell assign vs these passes) is attributable
+    with jax.named_scope("pip.hash_probe"):
+        u = _probe_slot(pcells, index)
     found = u >= 0
     banded_d = edge_eps2 is not None
     H = int(index.heavy_edges.shape[0])
@@ -1161,16 +1176,20 @@ def pip_join_points(
     px, py = pxy[:, 0], pxy[:, 1]
 
     banded = edge_eps2 is not None
-    if lookup in ("mxu", "mxu2"):
-        edges1, ebits1, geoms1, cores1, heavy1 = _tier1_rows_mxu(us, index)
-    else:
-        edges1, ebits1 = index.cell_edges[us], index.cell_ebits[us]
-        geoms1, cores1 = index.cell_slot_geom[us], index.cell_slot_core[us]
-        heavy1 = index.cell_heavy[us]
-    r1 = _ray_parity(px, py, edges1, ebits1, eps2=edge_eps2)
-    parity, near1 = r1 if banded else (r1, None)
-    best1 = _slot_best(parity, geoms1, cores1)
-    best1 = jnp.where(valid1, best1, _SENTINEL)
+    with jax.named_scope("pip.tier1"):
+        if lookup in ("mxu", "mxu2"):
+            edges1, ebits1, geoms1, cores1, heavy1 = _tier1_rows_mxu(
+                us, index
+            )
+        else:
+            edges1, ebits1 = index.cell_edges[us], index.cell_ebits[us]
+            geoms1 = index.cell_slot_geom[us]
+            cores1 = index.cell_slot_core[us]
+            heavy1 = index.cell_heavy[us]
+        r1 = _ray_parity(px, py, edges1, ebits1, eps2=edge_eps2)
+        parity, near1 = r1 if banded else (r1, None)
+        best1 = _slot_best(parity, geoms1, cores1)
+        best1 = jnp.where(valid1, best1, _SENTINEL)
 
     if H:
         # tier 2: compact again to the points whose cell is heavy
@@ -1233,6 +1252,10 @@ _JIT_JOIN = jax.jit(
     ),
 )
 
+# the epsilon-band recheck compacts the flagged band with the SAME
+# machinery the probe tiers use (`_compact`), jitted once per cap bucket
+_JIT_COMPACT = jax.jit(_compact, static_argnames=("cap",))
+
 
 def _next_pow2(n: int, lo: int = 16) -> int:
     return max(lo, 1 << int(np.ceil(np.log2(max(n, 1)))))
@@ -1285,6 +1308,8 @@ def pip_join(
     cell_dtype=None,
     writeback: str = "scatter",
     lookup: str | None = None,
+    cell_margin_k: float | None = None,
+    edge_band_k: float | None = None,
 ) -> np.ndarray:
     """Managed join (reference: `PointInPolygonJoin.join` auto-indexes both
     sides, `sql/join/PointInPolygonJoin.scala:86-97`).
@@ -1325,6 +1350,12 @@ def pip_join(
     the bench autotunes the winner per workload. ``lookup`` picks the
     tier-1 row access (``gather``/``mxu`` one-hot matmul); default None
     auto-selects ``mxu`` on accelerators for f32 indexes.
+
+    ``cell_margin_k`` / ``edge_band_k`` override the calibrated band
+    constants :data:`CELL_MARGIN_K` / :data:`EDGE_BAND_K` for this call —
+    the `tools/calibrate_margins.py` sweep knob (wider bands stay exact
+    but recheck more; narrower bands below the measured drift ceiling
+    lose the exactness contract).
     """
     resolution = index_system.resolution_arg(resolution)
     if chip_index is None:
@@ -1430,8 +1461,9 @@ def pip_join(
             return out
 
         # --- epsilon-band recheck (SURVEY §7) -------------------------
+        ebk = EDGE_BAND_K if edge_band_k is None else float(edge_band_k)
         eps2 = jnp.asarray(
-            (EDGE_BAND_K * float(np.finfo(np.dtype(dtype)).eps)
+            (ebk * float(np.finfo(np.dtype(dtype)).eps)
              * host.coord_scale) ** 2,
             dtype=dtype,
         )
@@ -1457,34 +1489,69 @@ def pip_join(
         # PIP-boundary band -> host (host_mask)
         if margins is not None:
             meps = float(np.finfo(np.dtype(margins.dtype)).eps)
-            km = CELL_MARGIN_K * meps
+            cmk = (
+                CELL_MARGIN_K if cell_margin_k is None
+                else float(cell_margin_k)
+            )
+            km = cmk * meps
+            t_rc = time.perf_counter()
             flagged = margins[..., 0] < km
             n_flag = int(flagged.sum())
             if n_flag:
-                # borderline cell assignments: re-join against the runner-
-                # up cell on device; only result TIES (plus cell corners
-                # and invalid alternates) escalate to the host oracle
+                # band-compacted narrow re-join: the epsilon band is
+                # compacted ONCE (the probe tiers' own `_compact`
+                # machinery) and a single re-join over just the compacted
+                # band — sized exactly from its own device-side counts,
+                # on the caller's tier-1 lookup path — resolves the
+                # runner-up cell. Only result TIES (plus cell corners and
+                # invalid alternates) escalate to the host oracle; the
+                # full point axis is never re-probed.
                 cap = min(_next_pow2(n_flag), chunk.shape[0])
-                fidx = jnp.nonzero(flagged, size=cap, fill_value=0)[0]
+                src, _, _, _ = _JIT_COMPACT(flagged, cap=cap)
                 alt = _assign_cells(
-                    index_system, resolution, dev[fidx], "alt"
+                    index_system, resolution, dev[src], "alt"
                 )
-                fidx_np = np.asarray(fidx)[:n_flag]
+                src_np = np.asarray(src)[:n_flag]
                 if alt is None:  # system without alternate-rounding
-                    host_mask[fidx_np] = True
+                    host_mask[src_np] = True
+                    _telemetry.record(
+                        "recheck_narrow", n=chunk.shape[0], band=n_flag,
+                        cap=cap, ties=n_flag, mode="host_all",
+                        seconds=round(time.perf_counter() - t_rc, 6),
+                    )
                 else:
+                    # exact caps for the narrow join from the band's own
+                    # two scalar counts (pad rows duplicate row 0, so the
+                    # counts upper-bound the real band — still exact)
+                    nf2, nh2 = (
+                        int(v)
+                        for v in np.asarray(_JIT_COUNTS(alt, chip_index))
+                    )
+                    fcap2 = min(_next_pow2(nf2 + 1), cap)
+                    hcap2 = (
+                        min(_next_pow2(nh2 + 1), fcap2)
+                        if chip_index.num_heavy_cells
+                        else None
+                    )
                     r_alt = np.asarray(
                         _JIT_JOIN(
-                            shifted[fidx], alt, chip_index,
-                            heavy_cap=None, found_cap=None,
+                            shifted[src], alt, chip_index,
+                            heavy_cap=hcap2, found_cap=fcap2,
+                            lookup=lookup,
                         )
                     )[:n_flag]
-                    vertex = np.asarray(margins[fidx, 1])[:n_flag] < km
+                    vertex = np.asarray(margins[src, 1])[:n_flag] < km
                     alt_np = np.asarray(alt)[:n_flag]
                     tie = (
-                        (r_alt != out[fidx_np]) | vertex | (alt_np < 0)
+                        (r_alt != out[src_np]) | vertex | (alt_np < 0)
                     )
-                    host_mask[fidx_np[tie]] = True
+                    host_mask[src_np[tie]] = True
+                    _telemetry.record(
+                        "recheck_narrow", n=chunk.shape[0], band=n_flag,
+                        cap=cap, caps=[fcap2, hcap2],
+                        ties=int(tie.sum()), mode="alt_rejoin",
+                        seconds=round(time.perf_counter() - t_rc, 6),
+                    )
         rows = np.nonzero(host_mask)[0]
         if rows.size:
             out[rows] = host_join(chunk[rows], host, index_system, resolution)

@@ -1,0 +1,353 @@
+"""Streaming join pipeline: HBM-resident batch ring + double-buffered
+cell-assignment prefetch.
+
+Why this layer exists (round-5 measurement, `STREAM_1B_r05.json`): the
+1B-point device-gen stream sustained 47.2M pts/s against a 132.2M pts/s
+single-batch rate (0.357x) because the `fori_loop` folded point
+*generation* into every iteration and nothing overlapped batch staging
+with the join. The 3DPipe lesson (PAPERS.md) is that the fix is
+structural: split the stream into pipelined stages and keep the next
+batch's inputs resident before the current batch's compute needs them.
+
+Three pieces, all CPU-testable and bit-identical to the per-batch path:
+
+- **Ring** — K pre-generated point batches stacked into one (K, B, 2)
+  HBM-resident array the loop cycles (`ring_from_host` /
+  `ring_from_generator`). Generator cost moves OUT of the measured loop;
+  `generator_rate` (an identical fori_loop running only `gen_batch`)
+  prices it separately.
+- **Prefetch** — inside the jitted scan, iteration i joins batch i with
+  the cell ids computed in iteration i-1 and computes batch i+1's cell
+  assignment in the same program. The two stages have no data dependency,
+  so XLA overlaps the cell pipeline (one-hot MXU work) with the PIP
+  probe's gather/scatter phases instead of serializing them.
+- **Accounting** — every stage emits a `stream_stage` telemetry event
+  (`runtime/telemetry.py`) with measured wall seconds, and
+  :func:`hbm_peak` reports the loop's high-water device memory — from
+  runtime memory stats when the backend exposes them, else a live-buffer
+  census (the axon tunnel returns no stats: STREAM_1B_r05 recorded
+  ``peak_hbm_bytes: 0``; that zero is the bug this closes).
+
+Completion is always forced by :func:`fold_stats` — a device-side
+(checksum, matches, overflow) fold so no per-point data crosses the
+host link inside a measured region.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..runtime import telemetry as _telemetry
+from .join import ChipIndex, pip_join_points
+
+
+def fold_stats(out: jax.Array) -> jax.Array:
+    """(3,) int32 device-side completion fold of a join output: full-bit
+    XOR-shift checksum (every result bit stays live — a masked sum lets
+    XLA dead-code the high half), match count, overflow count."""
+    return jnp.stack(
+        [
+            (out ^ (out >> 16)).sum().astype(jnp.int32),
+            (out >= 0).sum().astype(jnp.int32),
+            (out == -2).sum().astype(jnp.int32),
+        ]
+    )
+
+
+def ring_from_host(batches) -> jax.Array:
+    """Stack host point batches into one (K, B, 2) f64 device-resident
+    ring. Blocks until the ring is staged (staging is not loop time)."""
+    with _telemetry.timed("stream_stage", stage="ring_build", source="host"):
+        ring = jnp.stack([jnp.asarray(b, dtype=jnp.float64) for b in batches])
+        ring.block_until_ready()
+    return ring
+
+
+def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
+    """Device-generated ring: ``gen(fold_in(key, i)) -> (B, 2)`` for K
+    distinct slots, stacked resident in HBM."""
+    with _telemetry.timed(
+        "stream_stage", stage="ring_build", source="device_gen", k=k
+    ):
+        ring = jnp.stack(
+            [gen(jax.random.fold_in(key, i)) for i in range(k)]
+        )
+        ring.block_until_ready()
+    return ring
+
+
+def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
+    """(peak_bytes, source) for ``device`` (default: first device).
+
+    Prefers the runtime's ``memory_stats()`` high-water mark; when the
+    backend reports none (CPU, and the axon TPU tunnel — the source of
+    the ``peak_hbm_bytes: 0`` artifact bug), falls back to a census of
+    live device buffers (ring + index + loop carries are resident at the
+    high-water point, so this lower-bounds the true peak).
+    """
+    dev = device if device is not None else jax.devices()[0]
+    try:
+        st = dev.memory_stats() or {}
+    except Exception:
+        st = {}
+    for key in ("peak_bytes_in_use", "bytes_in_use", "bytes_used"):
+        v = int(st.get(key, 0) or 0)
+        if v > 0:
+            return v, f"memory_stats.{key}"
+    total = 0
+    try:
+        arrays = list(jax.live_arrays())
+    except Exception:
+        arrays = list(fallback_arrays)
+    for a in arrays:
+        try:
+            total += int(a.nbytes)
+        except Exception:
+            pass
+    return total, "live_buffer_census"
+
+
+@dataclasses.dataclass
+class StreamResult:
+    """One streamed run: device-fold stats + wall-clock accounting."""
+
+    checksum: int
+    matches: int
+    overflow: int
+    n_points: int
+    n_batches: int
+    batch: int
+    wall_s: float
+    points_per_sec: float
+    prefetch: bool
+    outs: np.ndarray | None = None  # (nb, B) per-batch rows (collect=True)
+
+
+class StreamJoin:
+    """Compiled streaming pip-join over a resident ring.
+
+    Splits the fused bench step into its two stages — ``assign`` (grid
+    cell ids) and ``join`` (the PIP probe) — and compiles one scan that
+    cycles ring slots with optional double-buffered prefetch of the next
+    batch's cell assignment. ``run`` (prefetch on) is bit-identical to
+    ``run_batched`` (one call per batch, no pipeline): cell assignment is
+    deterministic, so joining batch i against cells computed one
+    iteration early changes scheduling, never values — pinned by
+    tests/test_stream.py.
+    """
+
+    def __init__(
+        self,
+        index: ChipIndex,
+        index_system,
+        resolution: int,
+        *,
+        found_cap: int | None = None,
+        heavy_cap: int | None = None,
+        lookup: str | None = None,
+        compaction: str | None = None,
+        cell_dtype=jnp.float32,
+        prefetch: bool = True,
+    ):
+        self.index = index
+        self.prefetch = bool(prefetch)
+        dtype = index.border.verts.dtype
+        platform = jax.devices()[0].platform
+        if lookup is None:
+            lookup = (
+                "mxu"
+                if platform != "cpu" and dtype == jnp.float32
+                else "gather"
+            )
+        if compaction is None:
+            compaction = "scatter" if platform == "cpu" else "mxu"
+        self.lookup, self.compaction = lookup, compaction
+        self.found_cap, self.heavy_cap = found_cap, heavy_cap
+
+        def assign(pts):
+            c = index_system.point_to_cell(
+                pts.astype(cell_dtype), resolution
+            )
+            return c.astype(jnp.int64)
+
+        def join(pts, cells, chip_index):
+            shifted = (pts - chip_index.border.shift).astype(dtype)
+            return pip_join_points(
+                shifted,
+                cells,
+                chip_index,
+                heavy_cap=heavy_cap,
+                found_cap=found_cap,
+                lookup=lookup,
+                compaction=compaction,
+            )
+
+        self.assign = jax.jit(assign)
+        self.join = jax.jit(join)
+        self._step = jax.jit(lambda pts, ix: join(pts, assign(pts), ix))
+        # fused step + fold: benches time THIS (one (3,) pull forces
+        # completion; pulling the (N,) rows would measure the tunnel)
+        self._step_stats = jax.jit(
+            lambda pts, ix: fold_stats(join(pts, assign(pts), ix))
+        )
+
+        def loop(ring, chip_index, nb: int, collect: bool):
+            k = ring.shape[0]
+
+            def slot(i):
+                return jax.lax.dynamic_index_in_dim(
+                    ring, i % k, axis=0, keepdims=False
+                )
+
+            if self.prefetch:
+
+                def body(carry, i):
+                    acc, cells_cur = carry
+                    # join batch i against the cells prefetched at i-1;
+                    # assign batch i+1's cells in the SAME program so XLA
+                    # overlaps the cell pipeline with the probe
+                    out = join(slot(i), cells_cur, chip_index)
+                    cells_next = assign(slot(i + 1))
+                    return (acc + fold_stats(out), cells_next), (
+                        out if collect else None
+                    )
+
+                carry0 = (jnp.zeros(3, jnp.int32), assign(ring[0]))
+            else:
+
+                def body(carry, i):
+                    pts = slot(i)
+                    out = join(pts, assign(pts), chip_index)
+                    return carry + fold_stats(out), (
+                        out if collect else None
+                    )
+
+                carry0 = jnp.zeros(3, jnp.int32)
+            carry, outs = jax.lax.scan(
+                body, carry0, jnp.arange(nb, dtype=jnp.int32)
+            )
+            acc = carry[0] if self.prefetch else carry
+            return acc, outs
+
+        self._loop = jax.jit(loop, static_argnames=("nb", "collect"))
+
+    def step(self, pts: jax.Array) -> jax.Array:
+        """Single fused batch (assign + join) — the single-batch-rate
+        reference the sustained number is measured against."""
+        return self._step(pts, self.index)
+
+    def step_stats(self, pts: jax.Array) -> jax.Array:
+        """Single fused batch, device-folded to (3,) stats."""
+        return self._step_stats(pts, self.index)
+
+    def compile(self, ring: jax.Array, n_batches: int, collect=False):
+        """Warm the loop program (compile time must not pollute the
+        sustained measurement); emits a ``stream_stage`` compile event."""
+        with _telemetry.timed(
+            "stream_stage", stage="compile", n_batches=n_batches,
+            prefetch=self.prefetch,
+        ):
+            acc, outs = self._loop(ring, self.index, n_batches, collect)
+            jax.block_until_ready(acc)
+        return acc, outs
+
+    def run(
+        self, ring: jax.Array, n_batches: int, *, collect: bool = False
+    ) -> StreamResult:
+        """One timed streamed pass over ``n_batches`` ring cycles.
+
+        The whole stream is ONE dispatch (per-batch python dispatch over
+        the tunnel measured 146 ms/batch for a 63 ms device step in r05);
+        completion is forced by pulling the (3,) fold.
+        """
+        k, batch = int(ring.shape[0]), int(ring.shape[1])
+        t0 = time.perf_counter()
+        acc, outs = self._loop(ring, self.index, n_batches, collect)
+        acc_np = np.asarray(acc)  # blocks: the loop's only host pull
+        wall = time.perf_counter() - t0
+        n_points = n_batches * batch
+        _telemetry.record(
+            "stream_stage", stage="join_loop",
+            seconds=round(wall, 6), n_batches=n_batches, batch=batch,
+            ring_k=k, prefetch=self.prefetch,
+            points_per_sec=round(n_points / max(wall, 1e-9), 1),
+        )
+        return StreamResult(
+            checksum=int(acc_np[0]),
+            matches=int(acc_np[1]),
+            overflow=int(acc_np[2]),
+            n_points=n_points,
+            n_batches=n_batches,
+            batch=batch,
+            wall_s=wall,
+            points_per_sec=n_points / max(wall, 1e-9),
+            prefetch=self.prefetch,
+            outs=np.asarray(outs) if collect else None,
+        )
+
+    def run_batched(self, ring: jax.Array, n_batches: int) -> StreamResult:
+        """Per-batch reference path: one ``step`` call per ring slot, no
+        pipeline, host-accumulated stats — the bit-identity oracle for
+        the scanned loop (and the honest non-overlapped comparison)."""
+        k, batch = int(ring.shape[0]), int(ring.shape[1])
+        outs, acc = [], np.zeros(3, np.int64)
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            out = self.step(ring[i % k])
+            outs.append(np.asarray(out))
+            acc += np.asarray(fold_stats(out), dtype=np.int64)
+        wall = time.perf_counter() - t0
+        n_points = n_batches * batch
+        # int32 wraparound to match the device-side accumulator
+        c = int(acc[0]) & 0xFFFFFFFF
+        if c >= 1 << 31:
+            c -= 1 << 32
+        return StreamResult(
+            checksum=c,
+            matches=int(acc[1]),
+            overflow=int(acc[2]),
+            n_points=n_points,
+            n_batches=n_batches,
+            batch=batch,
+            wall_s=wall,
+            points_per_sec=n_points / max(wall, 1e-9),
+            prefetch=False,
+            outs=np.stack(outs),
+        )
+
+
+def generator_rate(
+    gen, key: jax.Array, n_batches: int, batch: int
+) -> tuple[float, float]:
+    """(points_per_sec, wall_s) of ``gen`` alone in a fori_loop identical
+    in shape to the join loop — the generator cost the r05 stream silently
+    folded into its sustained number. The full-array sum keeps every
+    generated element live (a partial fold would let XLA skip most of the
+    generation work)."""
+
+    @functools.partial(jax.jit, static_argnames=("nb",))
+    def gen_loop(k, nb):
+        def body(i, acc):
+            return acc + gen(jax.random.fold_in(k, i)).sum()
+
+        return jax.lax.fori_loop(0, nb, body, jnp.zeros((), jnp.float64))
+
+    with _telemetry.timed(
+        "stream_stage", stage="gen_compile", n_batches=n_batches
+    ):
+        float(gen_loop(key, n_batches))
+    t0 = time.perf_counter()
+    float(gen_loop(key, n_batches))
+    wall = max(time.perf_counter() - t0, 1e-9)
+    rate = n_batches * batch / wall
+    _telemetry.record(
+        "stream_stage", stage="gen_loop", seconds=round(wall, 6),
+        n_batches=n_batches, batch=batch, points_per_sec=round(rate, 1),
+    )
+    return rate, wall

@@ -14,13 +14,18 @@ f32. These tests pin the three layers that close the gap:
    the exact f64 host join everywhere.
 """
 
+import json
+import os
+
 import jax.numpy as jnp
 import numpy as np
 
 from mosaic_tpu.core.geometry import wkt
 from mosaic_tpu.core.index import BNG, H3
+from mosaic_tpu.runtime import telemetry
 from mosaic_tpu.sql.join import (
     CELL_MARGIN_K,
+    EDGE_BAND_K,
     build_chip_index,
     host_join,
     pip_join,
@@ -28,6 +33,9 @@ from mosaic_tpu.sql.join import (
 from mosaic_tpu.core.tessellate import tessellate
 
 EPS32 = float(np.finfo(np.float32).eps)
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "goldens", "recheck_margins.json"
+)
 
 
 def _global_points(n, seed=3):
@@ -212,6 +220,109 @@ def test_recheck_requires_host_companion():
     )
     with pytest.raises(ValueError, match="host companion"):
         pip_join(pts, None, H3, 8, chip_index=stripped, recheck=True)
+
+
+def test_margin_golden_two_x_headroom():
+    """The committed calibration sweep (`tools/calibrate_margins.py`)
+    pins the measured drift ceiling; the shipped band constants must keep
+    >= 2x headroom over it, and the golden must be regenerated whenever
+    the defaults change (the tool records them)."""
+    with open(GOLDEN) as f:
+        g = json.load(f)
+    assert g["defaults"] == {
+        "CELL_MARGIN_K": CELL_MARGIN_K,
+        "EDGE_BAND_K": EDGE_BAND_K,
+    }, "constants changed: rerun tools/calibrate_margins.py"
+    cell_max = g["cell_margin"]["max_observed_k"]
+    edge_max = g["edge_band"]["max_observed_k"]
+    assert cell_max > 0, "sweep found no cell disagreements — no signal"
+    assert edge_max > 0, "sweep found no edge disagreements — no signal"
+    assert 2 * cell_max <= CELL_MARGIN_K, (
+        f"cell drift {cell_max}·eps leaves <2x headroom under "
+        f"CELL_MARGIN_K={CELL_MARGIN_K}"
+    )
+    assert 2 * edge_max <= EDGE_BAND_K, (
+        f"edge drift {edge_max}·eps·scale leaves <2x headroom under "
+        f"EDGE_BAND_K={EDGE_BAND_K}"
+    )
+
+
+def test_margin_golden_matches_fresh_measurement():
+    """A fresh (smaller) drift measurement stays under the golden's 2x-
+    headroom ceiling — catches silent drift in the cell pipeline."""
+    import sys
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+    )
+    from calibrate_margins import global_points, measure_cell_drift
+
+    r = measure_cell_drift(H3, global_points(40_000, seed=21), 9)
+    assert 2 * r["max_observed_k"] <= CELL_MARGIN_K
+
+
+def test_recheck_runs_one_narrow_compacted_rejoin():
+    """The recheck issue-path must be ONE band-compacted narrow re-join —
+    never a full-width pass: exactly one `recheck_narrow` event per
+    batch, its compacted cap strictly below the batch width, its caps
+    sized to the band, and the result still exactly equal to f64."""
+    col = _nyc_zones()
+    res = 9
+    rng = np.random.default_rng(13)
+    pts = np.column_stack(
+        [rng.uniform(-74.05, -73.87, 30_000),
+         rng.uniform(40.68, 40.82, 30_000)]
+    )
+    table = tessellate(col, H3, res, keep_core_geoms=False)
+    idx = build_chip_index(table)
+    with telemetry.capture() as events:
+        got = pip_join(
+            pts, None, H3, res, chip_index=idx,
+            recheck=True, cell_dtype=jnp.float32,
+        )
+    want = host_join(pts, idx.host, H3, res)
+    np.testing.assert_array_equal(got, want)
+    narrow = [e for e in events if e["event"] == "recheck_narrow"]
+    assert len(narrow) == 1, narrow
+    e = narrow[0]
+    assert e["mode"] == "alt_rejoin"
+    assert 0 < e["band"] <= e["cap"] < e["n"] == pts.shape[0]
+    # the re-join is sized to the band, not the batch
+    assert e["caps"][0] <= e["cap"]
+    assert e["ties"] >= 0 and e["seconds"] >= 0
+
+
+def test_recheck_narrow_respects_margin_override():
+    """cell_margin_k=0 disables the cell band entirely (no narrow event);
+    a wider band flags more points than the default."""
+    col = _nyc_zones()
+    res = 9
+    rng = np.random.default_rng(8)
+    pts = np.column_stack(
+        [rng.uniform(-74.05, -73.87, 8_000),
+         rng.uniform(40.68, 40.82, 8_000)]
+    )
+    idx = build_chip_index(tessellate(col, H3, res, keep_core_geoms=False))
+    with telemetry.capture() as ev0:
+        pip_join(
+            pts, None, H3, res, chip_index=idx, recheck=True,
+            cell_dtype=jnp.float32, cell_margin_k=0.0,
+        )
+    assert not [e for e in ev0 if e["event"] == "recheck_narrow"]
+    with telemetry.capture() as ev_def:
+        pip_join(
+            pts, None, H3, res, chip_index=idx, recheck=True,
+            cell_dtype=jnp.float32,
+        )
+    with telemetry.capture() as ev_wide:
+        pip_join(
+            pts, None, H3, res, chip_index=idx, recheck=True,
+            cell_dtype=jnp.float32, cell_margin_k=4 * CELL_MARGIN_K,
+        )
+    band_def = [e for e in ev_def if e["event"] == "recheck_narrow"]
+    band_wide = [e for e in ev_wide if e["event"] == "recheck_narrow"]
+    assert band_def and band_wide
+    assert band_wide[0]["band"] > band_def[0]["band"]
 
 
 def test_pip_join_recheck_bng_no_alt_fallback():

@@ -1,24 +1,33 @@
 """Streamed ≥100M-point PIP join: the 1B-point north-star architecture.
 
-Reference analog: the Quickstart benchmark joins billions of points by
-letting Spark stream partitions through executors; here one chip streams
-host-generated batches through the fused cell-assign + probe step with
-DOUBLE BUFFERING — batch i+1's H2D transfer and batch i's compute overlap
-because JAX dispatch is asynchronous; the loop only forces batch i-1's
-device-side checksum.
+Round-5 diagnosis (`STREAM_1B_r05.json`): the device-gen stream sustained
+47.2M pts/s against a 132.2M single-batch rate (0.357x) because point
+GENERATION ran inside every loop iteration and nothing overlapped cell
+assignment with the probe — and `peak_hbm_bytes` came back 0 because the
+axon tunnel exposes no memory stats. This bench now measures through the
+`mosaic_tpu.sql.stream` pipeline layer, which separates the stages:
 
-Emits ONE JSON line (artifact: STREAM_r05.json when --out is given):
-sustained points/sec over the whole stream, the single-batch compute rate
-for the same compiled step, and their ratio. On this rig the host↔device
-tunnel runs at ~10 MB/s, so host-streamed mode is transfer-bound by three
-orders of magnitude (reported, not hidden: ``tunnel_limited``);
-``--device-gen`` streams device-generated batches through the identical
-loop to validate the pipeline at full rate (the bench's scale lane does
-the same for 16M).
+- **generator rate** — `gen_batch` alone in an identical fori_loop;
+- **pure-join sustained rate** (the headline `value` in ring mode) — the
+  loop cycles a pre-generated ring of K batches resident in HBM, with
+  double-buffered prefetch of batch i+1's cell assignment overlapping
+  batch i's PIP passes (`--no-ab` skips the prefetch-off comparison);
+- **single-batch rate** — the same fused step on one pre-staged batch;
+  `sustained_frac_of_single` is pure-join sustained over this;
+- **peak_hbm_bytes** — runtime memory stats at the loop's high-water
+  mark, falling back to a live-buffer census when the backend reports
+  none (never 0 again); per-stage wall timings ride along in
+  ``detail.stages`` (captured `stream_stage` telemetry events).
+
+The final stdout line is ALWAYS one machine-parseable JSON object (all
+other output goes to stderr). ``--verify`` (CPU CI) additionally asserts
+the streamed loop is bit-identical to the per-batch path.
 
 Usage:
-  python tools/stream_bench.py --points 100000000 [--device-gen] [--out F]
-  (CPU validation: MOSAIC_BENCH_PLATFORM=cpu --points 2000000)
+  python tools/stream_bench.py --points 1000000000 --device-gen [--out F]
+  python tools/stream_bench.py --points 100000000            # host-stream
+  (CPU validation: MOSAIC_BENCH_PLATFORM=cpu --points 200000
+   --batch 50000 --ring 2 --device-gen --verify)
 """
 
 from __future__ import annotations
@@ -35,219 +44,309 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _bucket(n: int) -> int:
+    """bench.py's cap bucketing: pow2 below 128k, 128k multiples above —
+    cap size directly scales tier gather/matmul cost."""
+    if n <= 131072:
+        return max(16, 1 << int(np.ceil(np.log2(n + 1))))
+    return (n + 131071) // 131072 * 131072
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--points", type=int, default=100_000_000)
     ap.add_argument("--batch", type=int, default=4_000_000)
-    ap.add_argument("--device-gen", action="store_true")
+    ap.add_argument("--ring", type=int, default=8,
+                    help="HBM-resident ring slots (device-gen mode)")
+    ap.add_argument("--device-gen", action="store_true",
+                    help="pure-join ring mode (device-generated batches)")
+    ap.add_argument("--no-ab", action="store_true",
+                    help="skip the prefetch-off comparison compile")
+    ap.add_argument("--fused", action="store_true",
+                    help="also run the r05-style gen-in-loop stream")
+    ap.add_argument("--verify", action="store_true",
+                    help="assert stream == per-batch bit-identity (CPU)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    if os.environ.get("MOSAIC_BENCH_PLATFORM") == "cpu":
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    import functools
-
-    import jax
-    import jax.numpy as jnp
-
-    from bench import RES, _load_or_build_index, _load_zones
-    from mosaic_tpu.core.index.h3 import H3IndexSystem
-    from mosaic_tpu.sql.join import pip_join_points
+    # the LAST stdout line must be the JSON artifact: stray library prints
+    # and progress chatter all divert to stderr
+    emit_to = sys.stdout
+    sys.stdout = sys.stderr
 
     t_all = time.perf_counter()
-    h3 = H3IndexSystem()
-    zones, zones_src = _load_zones()
-    b = zones.bounds()
-    bbox = (
-        float(np.nanmin(b[:, 0])), float(np.nanmin(b[:, 1])),
-        float(np.nanmax(b[:, 2])), float(np.nanmax(b[:, 3])),
-    )
-    index, _, _ = _load_or_build_index(zones, zones_src, h3)
-    dtype = index.border.verts.dtype
-    dev = jax.devices()[0]
-
-    batch = min(args.batch, args.points)
-    n_batches = (args.points + batch - 1) // batch
-
-    @functools.partial(jax.jit, static_argnames=("fcap", "hcap"))
-    def step(points_f64, chip_index, fcap, hcap):
-        cells = h3.point_to_cell(points_f64.astype(jnp.float32), RES)
-        shifted = (points_f64 - chip_index.border.shift).astype(dtype)
-        out = pip_join_points(
-            shifted, cells.astype(jnp.int64), chip_index,
-            heavy_cap=hcap, found_cap=fcap,
-            lookup="gather" if jax.devices()[0].platform == "cpu" else "mxu",
-            compaction="scatter" if jax.devices()[0].platform == "cpu"
-            else "mxu",
-        )
-        # device-side fold: checksum + match count + overflow count force
-        # completion without streaming 4 B/point back over the link
-        return (out ^ (out >> 16)).sum(), (out >= 0).sum(), (out == -2).sum()
-
-    def bucket(n):
-        """bench.py's cap bucketing: pow2 below 128k, 128k multiples
-        above — cap size directly scales tier gather/matmul cost, so the
-        old flat +65536 slack (which forced hcap to 131072 on NYC where
-        65536 suffices) cost real throughput."""
-        if n <= 131072:
-            return max(16, 1 << int(np.ceil(np.log2(n + 1))))
-        return (n + 131071) // 131072 * 131072
-
-    # caps from a host presample, margined like bench.py; an overflow in
-    # any batch is counted on device and reported in detail.overflow
-    rng = np.random.default_rng(77)
-    pre = rng.uniform(bbox[:2], bbox[2:], (200_000, 2))
-    pre_cells = np.asarray(h3.point_to_cell(jnp.asarray(pre, jnp.float32), RES))
-    cells_np = np.asarray(index.cells)
-    pos = np.clip(np.searchsorted(cells_np, pre_cells), 0, cells_np.size - 1)
-    ffrac = float((cells_np[pos] == pre_cells).mean())
-    fcap = min(bucket(int(1.5 * ffrac * batch)), batch)
-    hmask = np.asarray(index.cell_heavy) >= 0
-    hfrac = float(np.isin(pre_cells, cells_np[hmask]).mean())
-    hcap = min(bucket(int(1.5 * hfrac * batch)), fcap)
-
-    lo = jnp.asarray(bbox[:2], dtype=jnp.float64)
-    span = jnp.asarray(
-        [bbox[2] - bbox[0], bbox[3] - bbox[1]], dtype=jnp.float64
-    )
-
-    @functools.partial(jax.jit, static_argnames=("n",))
-    def gen_batch(key, n):
-        u = jax.random.uniform(key, (n, 2), dtype=jnp.float32)
-        return (lo + u * span).astype(jnp.float64)
-
-    def host_batch(i):
-        r = np.random.default_rng(1000 + i)
-        return r.uniform(bbox[:2], bbox[2:], (batch, 2))
-
-    key = jax.random.PRNGKey(5)
-
-    def stage(i):
-        if args.device_gen:
-            return gen_batch(jax.random.fold_in(key, i), batch)
-        return jax.device_put(jnp.asarray(host_batch(i)))
-
-    # tunnel round-trip: every blocking scalar pull pays this (~60 ms on
-    # the axon tunnel) — it must stay OUT of the streamed loop
-    rtt_t = time.perf_counter()
-    float(jnp.float32(1.0) + 1.0)
-    rtt = time.perf_counter() - rtt_t
-
-    # compile + single-batch compute rate (pre-staged input, like bench)
-    warm = stage(0)
-    warm.block_until_ready()
-    s0, m0, v0 = step(warm, index, fcap, hcap)
-    float(s0)
-    reps = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        s0, m0, v0 = step(warm, index, fcap, hcap)
-        float(s0)
-        reps.append(time.perf_counter() - t0)
-    # rtt can exceed a fully-pipelined wall sample on the tunnel: floor
-    # the device estimate at 20% of wall rather than going negative
-    single_s = max(min(reps) - rtt, min(reps) * 0.2, 1e-9)
-    single_rate = batch / single_s
-
-    h2d_s = 0.0
-    if args.device_gen:
-        # device-gen streams the WHOLE run inside one jitted fori_loop:
-        # one dispatch, one result pull. Per-batch python dispatch over
-        # the axon tunnel does NOT overlap with device execution
-        # (measured 2026-07-31: ~146 ms/batch wall for a ~63 ms device
-        # step even with device-side accumulation and 16-batch syncs), so
-        # the host loop was tunnel-dispatch-bound, not compute-bound.
-        # This is also the honest 1B-point shape: a real ingest pipeline
-        # keeps the device fed without a host round trip per batch.
-        @functools.partial(jax.jit, static_argnames=("nb",))
-        def stream_dev(k, nb):
-            def body(i, c):
-                s, m, v = c
-                pts = gen_batch(jax.random.fold_in(k, i), batch)
-                s2, m2, v2 = step(pts, index, fcap, hcap)
-                # x64 mode promotes the bool-sum counts to i64: keep the
-                # carry i32 (counts stay < 2^31 even at 1B points)
-                return (
-                    s + s2.astype(jnp.int32),
-                    m + m2.astype(jnp.int32),
-                    v + v2.astype(jnp.int32),
-                )
-            z = jnp.zeros((), jnp.int32)
-            return jax.lax.fori_loop(0, nb, body, (z, z, z))
-
-        s_tot, m_tot, v_tot = stream_dev(key, n_batches)  # compile
-        float(s_tot)
-        t0 = time.perf_counter()
-        s_tot, m_tot, v_tot = stream_dev(key, n_batches)
-        float(s_tot)
-        wall = time.perf_counter() - t0 - rtt
-    else:
-        # host-stream: double-buffered H2D; checksum + match count
-        # accumulate ON DEVICE and cross the tunnel once per SYNC_EVERY
-        # batches (a per-batch float() costs one ~60 ms round trip each,
-        # which alone capped a 25-batch 100M stream at ~20M pts/s)
-        SYNC_EVERY = 16
-        t0 = time.perf_counter()
-        s_tot = m_tot = v_tot = None
-        nxt = stage(0)
-        for i in range(n_batches):
-            cur = nxt
-            if i + 1 < n_batches:
-                th = time.perf_counter()
-                nxt = stage(i + 1)  # async put/gen overlaps batch i
-                h2d_s += time.perf_counter() - th
-            s, m, v = step(cur, index, fcap, hcap)
-            s_tot = s if s_tot is None else s_tot + s
-            m_tot = m if m_tot is None else m_tot + m
-            v_tot = v if v_tot is None else v_tot + v
-            if (i + 1) % SYNC_EVERY == 0:
-                float(s_tot)
-        float(s_tot)
-        wall = time.perf_counter() - t0
-    matches = int(m_tot)
-    overflow = int(v_tot)
-    n_total = n_batches * batch
-    sustained = n_total / wall
-
-    mem = {}
-    try:
-        st = dev.memory_stats() or {}
-        mem = {"peak_hbm_bytes": int(st.get("peak_bytes_in_use", 0))}
-    except Exception:
-        pass
-
+    detail: dict = {}
     line = {
         "metric": "stream_join_sustained",
-        "value": round(sustained, 1),
+        "value": 0.0,
         "unit": "points/sec/chip",
-        "detail": {
-            "mode": "device-gen" if args.device_gen else "host-stream",
-            "n_points": n_total,
-            "n_batches": n_batches,
-            "batch": batch,
-            "wall_s": round(wall, 2),
-            "host_stage_s": round(h2d_s, 2),
-            "single_batch_rate": round(single_rate, 1),
-            "sustained_frac_of_single": round(sustained / single_rate, 4),
-            "tunnel_limited": bool(
-                not args.device_gen and sustained < 0.5 * single_rate
-            ),
-            "match_rate": round(matches / n_total, 4),
-            "overflow": overflow,
-            "caps": [fcap, hcap],
-            "device": str(dev),
-            "zones": zones_src,
-            "total_wall_s": round(time.perf_counter() - t_all, 1),
-            **mem,
-        },
+        "detail": detail,
     }
+    stages: list[dict] = []
+    try:
+        if os.environ.get("MOSAIC_BENCH_PLATFORM") == "cpu":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from bench import RES, _load_or_build_index, _load_zones
+        from mosaic_tpu.core.index.h3 import H3IndexSystem
+        from mosaic_tpu.runtime import telemetry
+        from mosaic_tpu.sql.stream import (
+            StreamJoin,
+            fold_stats,
+            generator_rate,
+            hbm_peak,
+            ring_from_generator,
+        )
+
+        cap_events = telemetry.capture()
+        stages = cap_events.__enter__()
+
+        h3 = H3IndexSystem()
+        zones, zones_src = _load_zones()
+        b = zones.bounds()
+        bbox = (
+            float(np.nanmin(b[:, 0])), float(np.nanmin(b[:, 1])),
+            float(np.nanmax(b[:, 2])), float(np.nanmax(b[:, 3])),
+        )
+        index, _, _ = _load_or_build_index(zones, zones_src, h3)
+        dev = jax.devices()[0]
+        detail.update(device=str(dev), zones=zones_src)
+
+        batch = min(args.batch, args.points)
+        n_batches = (args.points + batch - 1) // batch
+
+        # caps from a host presample, margined like bench.py; an overflow
+        # in any batch is counted on device, reported in detail.overflow
+        rng = np.random.default_rng(77)
+        n_pre = min(200_000, max(20_000, batch))
+        pre = rng.uniform(bbox[:2], bbox[2:], (n_pre, 2))
+        pre_cells = np.asarray(
+            h3.point_to_cell(jnp.asarray(pre, jnp.float32), RES)
+        )
+        cells_np = np.asarray(index.cells)
+        pos = np.clip(
+            np.searchsorted(cells_np, pre_cells), 0, cells_np.size - 1
+        )
+        ffrac = float((cells_np[pos] == pre_cells).mean())
+        fcap = min(_bucket(int(1.5 * ffrac * batch)), batch)
+        hmask = np.asarray(index.cell_heavy) >= 0
+        hfrac = float(np.isin(pre_cells, cells_np[hmask]).mean())
+        hcap = min(_bucket(int(1.5 * hfrac * batch)), fcap)
+
+        lo = jnp.asarray(bbox[:2], dtype=jnp.float64)
+        span = jnp.asarray(
+            [bbox[2] - bbox[0], bbox[3] - bbox[1]], dtype=jnp.float64
+        )
+
+        @jax.jit
+        def gen_batch(key):
+            u = jax.random.uniform(key, (batch, 2), dtype=jnp.float32)
+            return (lo + u * span).astype(jnp.float64)
+
+        key = jax.random.PRNGKey(5)
+        sj = StreamJoin(
+            index, h3, RES, found_cap=fcap, heavy_cap=hcap, prefetch=True
+        )
+        detail.update(
+            n_points=n_batches * batch, n_batches=n_batches, batch=batch,
+            caps=[fcap, hcap], lookup=sj.lookup, compaction=sj.compaction,
+        )
+
+        # tunnel round-trip: every blocking scalar pull pays this (~60 ms
+        # on the axon tunnel) — it must stay OUT of the streamed loop
+        rtt_t = time.perf_counter()
+        float(jnp.float32(1.0) + 1.0)
+        rtt = time.perf_counter() - rtt_t
+        detail["sync_rtt_s"] = round(rtt, 4)
+
+        # compile + single-batch compute rate (pre-staged input)
+        warm = gen_batch(jax.random.fold_in(key, 0))
+        warm.block_until_ready()
+        np.asarray(sj.step_stats(warm))
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(sj.step_stats(warm))
+            reps.append(time.perf_counter() - t0)
+        # rtt can exceed a fully-pipelined wall sample on the tunnel:
+        # floor the device estimate at 20% of wall, never negative
+        single_s = max(min(reps) - rtt, min(reps) * 0.2, 1e-9)
+        single_rate = batch / single_s
+        detail["single_batch_rate"] = round(single_rate, 1)
+
+        if args.device_gen:
+            detail["mode"] = "device-gen-ring"
+
+            # (1) the generator alone, in an identical fori_loop — the
+            # cost the r05 stream folded invisibly into its number
+            gen_rate, gen_wall = generator_rate(
+                gen_batch, key, n_batches, batch
+            )
+            detail["generator_points_per_sec"] = round(gen_rate, 1)
+            detail["gen_wall_s"] = round(gen_wall, 3)
+
+            # (2) the ring: K device-generated batches resident in HBM
+            k = max(2, min(args.ring, n_batches))
+            ring = ring_from_generator(gen_batch, key, k)
+            detail["ring_k"] = k
+            detail["ring_bytes"] = int(ring.nbytes)
+
+            # (3) the join loop over the ring, prefetch on — ONE
+            # dispatch, one (3,) result pull (per-batch python dispatch
+            # over the tunnel measured 146 ms/batch for a ~63 ms device
+            # step in r05: the host loop was dispatch-bound)
+            sj.compile(ring, n_batches)
+            res = sj.run(ring, n_batches)
+            join_wall = max(res.wall_s - rtt, 1e-9)
+            join_rate = res.n_points / join_wall
+            line["value"] = round(join_rate, 1)
+            detail.update(
+                join_points_per_sec=round(join_rate, 1),
+                join_wall_s=round(join_wall, 3),
+                prefetch=True,
+                sustained_frac_of_single=round(join_rate / single_rate, 4),
+                match_rate=round(res.matches / res.n_points, 4),
+                overflow=res.overflow,
+                checksum=res.checksum,
+            )
+
+            # (4) prefetch A/B: same ring without the double buffer
+            # (costs one extra loop compile — --no-ab on flaky tunnels)
+            if not args.no_ab:
+                sj0 = StreamJoin(
+                    index, h3, RES, found_cap=fcap, heavy_cap=hcap,
+                    lookup=sj.lookup, compaction=sj.compaction,
+                    prefetch=False,
+                )
+                sj0.compile(ring, n_batches)
+                r0 = sj0.run(ring, n_batches)
+                detail["no_prefetch_points_per_sec"] = round(
+                    r0.n_points / max(r0.wall_s - rtt, 1e-9), 1
+                )
+                if (r0.checksum, r0.matches, r0.overflow) != (
+                    res.checksum, res.matches, res.overflow
+                ):
+                    detail["prefetch_mismatch"] = True  # never expected
+
+            # (5) optional r05-comparable fused lane: gen inside the loop
+            if args.fused:
+                @functools.partial(jax.jit, static_argnames=("nb",))
+                def stream_fused(kk, nb):
+                    def body(i, acc):
+                        pts = gen_batch(jax.random.fold_in(kk, i))
+                        cells = sj.assign(pts)
+                        return acc + fold_stats(
+                            sj.join(pts, cells, index)
+                        )
+
+                    return jax.lax.fori_loop(
+                        0, nb, body, jnp.zeros(3, jnp.int32)
+                    )
+
+                np.asarray(stream_fused(key, n_batches))  # compile
+                t0 = time.perf_counter()
+                np.asarray(stream_fused(key, n_batches))
+                fw = max(time.perf_counter() - t0 - rtt, 1e-9)
+                detail["fused_points_per_sec"] = round(
+                    n_batches * batch / fw, 1
+                )
+
+            # (6) high-water memory AFTER the loop (cumulative peak)
+            peak, src = hbm_peak(dev, fallback_arrays=[ring])
+            detail["peak_hbm_bytes"] = peak
+            detail["hbm_source"] = src
+
+            # (7) bit-identity against the per-batch path (CPU CI)
+            if args.verify:
+                nb_v = min(n_batches, 2 * k + 1)
+                rs = sj.run(ring, nb_v, collect=True)
+                rb = sj.run_batched(ring, nb_v)
+                same = bool(np.array_equal(rs.outs, rb.outs)) and (
+                    rs.checksum, rs.matches, rs.overflow
+                ) == (rb.checksum, rb.matches, rb.overflow)
+                detail["verified"] = same
+                if not same:
+                    raise AssertionError("stream path != per-batch path")
+        else:
+            # host-stream: double-buffered H2D; stats accumulate ON
+            # DEVICE and cross the tunnel once per SYNC_EVERY batches (a
+            # per-batch float() costs one ~60 ms round trip each, which
+            # alone capped a 25-batch 100M stream at ~20M pts/s). The
+            # tunnel runs ~10 MB/s: this mode is transfer-bound by three
+            # orders of magnitude (reported, not hidden).
+            detail["mode"] = "host-stream"
+            fold = jax.jit(fold_stats)
+
+            def host_batch(i):
+                r = np.random.default_rng(1000 + i)
+                return r.uniform(bbox[:2], bbox[2:], (batch, 2))
+
+            def stage_put(i):
+                return jax.device_put(jnp.asarray(host_batch(i)))
+
+            SYNC_EVERY = 16
+            h2d_s = 0.0
+            t0 = time.perf_counter()
+            acc = None
+            nxt = stage_put(0)
+            for i in range(n_batches):
+                cur = nxt
+                if i + 1 < n_batches:
+                    th = time.perf_counter()
+                    nxt = stage_put(i + 1)  # async put overlaps batch i
+                    h2d_s += time.perf_counter() - th
+                s = fold(sj.step(cur))
+                acc = s if acc is None else acc + s
+                if (i + 1) % SYNC_EVERY == 0:
+                    np.asarray(acc)
+            acc_np = np.asarray(acc)
+            wall = time.perf_counter() - t0
+            n_total = n_batches * batch
+            sustained = n_total / wall
+            line["value"] = round(sustained, 1)
+            detail.update(
+                wall_s=round(wall, 2),
+                host_stage_s=round(h2d_s, 2),
+                join_points_per_sec=round(sustained, 1),
+                sustained_frac_of_single=round(
+                    sustained / single_rate, 4
+                ),
+                tunnel_limited=bool(sustained < 0.5 * single_rate),
+                match_rate=round(int(acc_np[1]) / n_total, 4),
+                overflow=int(acc_np[2]),
+                checksum=int(acc_np[0]),
+            )
+            peak, src = hbm_peak(dev)
+            detail["peak_hbm_bytes"] = peak
+            detail["hbm_source"] = src
+        cap_events.__exit__(None, None, None)
+    except Exception as e:  # the artifact line must still parse
+        detail["error"] = repr(e)[:400]
+        try:
+            import jax as _j
+
+            detail.setdefault("device", str(_j.devices()[0]))
+        except Exception:
+            detail.setdefault("device", "unknown")
+
+    detail["stages"] = [
+        s for s in stages if s.get("event") == "stream_stage"
+    ]
+    detail["total_wall_s"] = round(time.perf_counter() - t_all, 1)
     out = json.dumps(line)
-    print(out)
+    emit_to.write(out + "\n")
+    emit_to.flush()
     if args.out:
         with open(args.out, "w") as f:
             f.write(out + "\n")
+    if detail.get("error") and not line["value"]:
+        sys.exit(1)
 
 
 if __name__ == "__main__":
