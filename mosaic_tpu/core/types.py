@@ -257,6 +257,12 @@ class PackedGeometry:
         return builder.build()
 
     def slice(self, start: int, stop: int) -> "PackedGeometry":
+        """Python-slice semantics: out-of-range bounds clamp instead of
+        raising (``col.slice(0, 6)`` of a 2-geometry column is the whole
+        column, exactly like ``seq[0:6]``)."""
+        n = len(self)
+        start = max(0, min(start + n if start < 0 else start, n))
+        stop = max(start, min(stop + n if stop < 0 else stop, n))
         return self.take(range(start, stop))
 
     # ------------------------------------------------------------ conversion

@@ -16,7 +16,15 @@ entry points instead:
 - :mod:`telemetry`  — structured events every escalation/retry/degradation
   emits (capturable in tests, logged via `utils.get_logger`);
 - :mod:`faults`     — context-manager fault injection (shrunken caps,
-  synthetic transient errors) exercising all of the above for real.
+  synthetic transient errors, simulated stalls, corrupted batches)
+  exercising all of the above for real;
+- :mod:`watchdog`   — monotonic-deadline guard around blocking device
+  operations (`StalledDeviceError` instead of a hang; ``MOSAIC_WATCHDOG_*``
+  knobs);
+- :mod:`checkpoint` — checksummed snapshot store (atomic write, corrupt-
+  skip on load) under resumable streaming runs;
+- :mod:`quarantine` — pre-admission input validation: poisoned rows land
+  in a reported quarantine buffer instead of the device fold.
 """
 
 from .errors import (
@@ -24,12 +32,13 @@ from .errors import (
     DegradedResult,
     MosaicRuntimeError,
     RetryExhausted,
+    StalledDeviceError,
     TransientDeviceError,
     is_transient,
 )
 from .escalate import EscalationPolicy, run_escalating
 from .retry import RetryPolicy, backoff_delays, call_with_retry, with_retry
-from . import faults, telemetry
+from . import checkpoint, faults, quarantine, telemetry, watchdog
 
 __all__ = [
     "CapacityOverflow",
@@ -38,12 +47,16 @@ __all__ = [
     "MosaicRuntimeError",
     "RetryExhausted",
     "RetryPolicy",
+    "StalledDeviceError",
     "TransientDeviceError",
     "backoff_delays",
     "call_with_retry",
+    "checkpoint",
     "faults",
     "is_transient",
+    "quarantine",
     "run_escalating",
     "telemetry",
+    "watchdog",
     "with_retry",
 ]

@@ -51,6 +51,26 @@ class TransientDeviceError(MosaicRuntimeError):
         self.site = site
 
 
+class StalledDeviceError(TransientDeviceError):
+    """A blocking device operation exceeded its watchdog deadline.
+
+    Raised by `runtime/watchdog.py` instead of letting a dispatch,
+    ``block_until_ready`` or snapshot D2H hang forever. Subclassing
+    :class:`TransientDeviceError` puts a stall on the same retry path as
+    a tunnel drop: bounded retry, then degradation or a typed failure —
+    never a silent hang. ``elapsed_s`` is how long the operation had been
+    blocked when the deadline fired.
+    """
+
+    def __init__(
+        self, message: str, *, site: str = "", deadline_s: float = 0.0,
+        elapsed_s: float = 0.0,
+    ):
+        super().__init__(message, site=site)
+        self.deadline_s = deadline_s
+        self.elapsed_s = elapsed_s
+
+
 class RetryExhausted(MosaicRuntimeError):
     """The bounded transient-retry budget ran out without a success.
 

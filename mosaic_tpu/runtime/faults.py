@@ -10,7 +10,16 @@ device entry points consult (`pip_join`, `dist_pip_join`,
 - :func:`transient_errors` raises a synthetic
   :class:`TransientDeviceError` on the first N guarded calls, modelling
   the remote-compile HTTP 500s observed on the axon tunnel;
-- :func:`inject` composes both.
+- :func:`stalls` plans a simulated hang (seconds of dead time) inside
+  the next N watchdog-guarded calls, so `runtime/watchdog.py` deadlines
+  are exercised for real (the mid-stream sites: ``stream.scan_step``,
+  ``stream.snapshot``, ``stream.prefetch``);
+- :func:`corrupt_batches` poisons the first rows of batches passing
+  through :func:`maybe_corrupt` (NaN coordinates by default) — the
+  quarantine layer's adversarial-input model;
+- :func:`inject` composes all of them; ``skip_first`` delays any of the
+  synthetic failures past the first N matching calls, which is how
+  tests kill a streaming run at an arbitrary snapshot boundary.
 
 With no plan installed every hook is a near-free no-op (one thread-local
 attribute read), so production paths pay nothing.
@@ -23,6 +32,8 @@ import dataclasses
 import fnmatch
 import threading
 
+import numpy as np
+
 from . import telemetry
 from .errors import TransientDeviceError
 
@@ -31,14 +42,27 @@ _LOCAL = threading.local()
 
 @dataclasses.dataclass
 class FaultPlan:
-    """One active injection: cap clamps + synthetic transient failures."""
+    """One active injection: cap clamps + synthetic transient failures +
+    simulated stalls + batch corruption."""
 
     cap_clamps: dict[str, int] = dataclasses.field(default_factory=dict)
     fail_first: int = 0
     sites: tuple[str, ...] = ("*",)
     exc_factory: "Callable[[str], BaseException] | None" = None
+    #: matching maybe_fail calls to let through before failing starts
+    skip_first: int = 0
+    #: simulated hang: seconds of dead time in the first N guarded calls
+    stall_s: float = 0.0
+    stall_first: int = 0
+    #: batch poison: overwrite the first N rows of each batch with value
+    corrupt_rows: int = 0
+    corrupt_value: float = float("nan")
+    corrupt_batches_n: int = 0
     #: mutable counters: guarded calls failed so far / trail of trip sites
     failed: int = 0
+    seen: int = 0
+    stalled: int = 0
+    corrupted: int = 0
     trips: list = dataclasses.field(default_factory=list)
 
     def matches(self, site: str) -> bool:
@@ -64,6 +88,12 @@ def inject(
     fail_first: int = 0,
     sites: tuple[str, ...] = ("*",),
     exc_factory: "Callable[[str], BaseException] | None" = None,
+    skip_first: int = 0,
+    stall_s: float = 0.0,
+    stall_first: int = 0,
+    corrupt_rows: int = 0,
+    corrupt_value: float = float("nan"),
+    corrupt_batches_n: int = 0,
 ):
     """Install a fault plan for the block; yields it (``plan.trips``
     records every synthetic failure actually raised)."""
@@ -72,6 +102,12 @@ def inject(
         fail_first=int(fail_first),
         sites=tuple(sites),
         exc_factory=exc_factory,
+        skip_first=int(skip_first),
+        stall_s=float(stall_s),
+        stall_first=int(stall_first),
+        corrupt_rows=int(corrupt_rows),
+        corrupt_value=float(corrupt_value),
+        corrupt_batches_n=int(corrupt_batches_n),
     )
     _plans().append(plan)
     try:
@@ -97,11 +133,50 @@ def transient_errors(
     n: int = 2,
     sites: tuple[str, ...] = ("*",),
     exc_factory: "Callable[[str], BaseException] | None" = None,
+    skip_first: int = 0,
 ):
     """Raise a synthetic transient error on the first ``n`` guarded calls
     matching ``sites`` (fnmatch patterns over hook names like
-    ``"pip_join.device"``)."""
-    return inject(fail_first=n, sites=sites, exc_factory=exc_factory)
+    ``"pip_join.device"`` or the stream sites ``"stream.scan_step"``,
+    ``"stream.snapshot"``, ``"stream.prefetch"``). ``skip_first`` lets
+    the first N matching calls through untouched — the kill-at-segment-M
+    knob the stream resume tests use."""
+    return inject(
+        fail_first=n, sites=sites, exc_factory=exc_factory,
+        skip_first=skip_first,
+    )
+
+
+def stalls(
+    seconds: float,
+    n: int = 1,
+    sites: tuple[str, ...] = ("*",),
+    skip_first: int = 0,
+):
+    """Simulate ``n`` device hangs of ``seconds`` dead time inside the
+    next watchdog-guarded calls matching ``sites`` — the watchdog must
+    convert each into a typed ``StalledDeviceError`` instead of letting
+    the caller block."""
+    return inject(
+        stall_s=seconds, stall_first=n, sites=sites, skip_first=skip_first,
+    )
+
+
+def corrupt_batches(
+    rows: int,
+    value: float = float("nan"),
+    n: int = 1 << 30,
+    sites: tuple[str, ...] = ("stream.admit",),
+):
+    """Poison the first ``rows`` rows of the next ``n`` batches passing
+    through :func:`maybe_corrupt` at ``sites`` with ``value`` (NaN by
+    default) — modelling adversarial/garbage rows inside an otherwise
+    healthy stream. The quarantine contract: exactly these rows (and no
+    others) must land in the quarantine buffer."""
+    return inject(
+        corrupt_rows=rows, corrupt_value=value, corrupt_batches_n=n,
+        sites=sites,
+    )
 
 
 def maybe_fail(site: str) -> None:
@@ -109,9 +184,16 @@ def maybe_fail(site: str) -> None:
 
     Placed at the top of each guarded device attempt so the retry layer
     sees the failure exactly where a real tunnel/compile error surfaces.
+    ``skip_first`` calls pass through before the failure budget starts
+    being spent (counted per plan across all matching sites).
     """
     for plan in _plans():
-        if plan.fail_first and plan.failed < plan.fail_first and plan.matches(site):
+        if plan.fail_first and plan.matches(site):
+            plan.seen += 1
+            if plan.seen <= plan.skip_first:
+                continue
+            if plan.failed >= plan.fail_first:
+                continue
             plan.failed += 1
             plan.trips.append(site)
             telemetry.record(
@@ -125,6 +207,47 @@ def maybe_fail(site: str) -> None:
                 f"({plan.failed}/{plan.fail_first})",
                 site=site,
             )
+
+
+def planned_stall(site: str) -> float:
+    """Hook (watchdog): seconds of simulated hang planned for ``site``,
+    consuming one unit of the plan's stall budget; 0.0 when none."""
+    for plan in _plans():
+        if (
+            plan.stall_first
+            and plan.stalled < plan.stall_first
+            and plan.matches(site)
+        ):
+            plan.stalled += 1
+            plan.trips.append(f"stall:{site}")
+            telemetry.record(
+                "fault_stall_injected", site=site,
+                seconds=plan.stall_s, n=plan.stalled, of=plan.stall_first,
+            )
+            return float(plan.stall_s)
+    return 0.0
+
+
+def maybe_corrupt(site: str, batch):
+    """Hook: return ``batch`` with the planned rows poisoned, or
+    unchanged (same object) when no corruption plan matches. Never
+    mutates the input array."""
+    for plan in _plans():
+        if (
+            plan.corrupt_rows
+            and plan.corrupted < plan.corrupt_batches_n
+            and plan.matches(site)
+        ):
+            plan.corrupted += 1
+            out = np.array(batch, dtype=np.float64, copy=True)
+            k = min(int(plan.corrupt_rows), out.shape[0])
+            out[:k] = plan.corrupt_value
+            telemetry.record(
+                "fault_batch_corrupted", site=site, rows=k,
+                value=repr(plan.corrupt_value), n=plan.corrupted,
+            )
+            return out
+    return batch
 
 
 def clamp_caps(caps: dict) -> dict:

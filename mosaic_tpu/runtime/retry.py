@@ -14,6 +14,9 @@ Env knobs (read at policy construction, i.e. per call site default):
 - ``MOSAIC_RETRY_BASE_S``    first backoff delay seconds (default 0.05)
 - ``MOSAIC_RETRY_MAX_S``     backoff ceiling seconds (default 2.0)
 - ``MOSAIC_RETRY_BUDGET_S``  total wall-clock budget seconds (default 60)
+- ``MOSAIC_RETRY_SEED``      seed the backoff jitter (default: entropy) —
+  resilience tests set it (or pass ``rng=``) so retry timing is
+  reproducible run to run instead of timing-flaky
 """
 
 from __future__ import annotations
@@ -60,11 +63,34 @@ class RetryPolicy:
         )
 
 
-def backoff_delays(policy: RetryPolicy) -> Iterator[float]:
-    """The policy's backoff schedule (one delay per retry, jittered)."""
+def _jitter_rng(rng: "random.Random | None") -> "random.Random":
+    """The jitter source: an injected ``rng`` wins; else a fresh
+    ``random.Random(MOSAIC_RETRY_SEED)`` when the env knob is set (each
+    schedule restarts the sequence — deterministic under test); else the
+    module-level entropy-seeded generator (production)."""
+    if rng is not None:
+        return rng
+    seed = os.environ.get("MOSAIC_RETRY_SEED")
+    if seed is not None:
+        try:
+            return random.Random(int(seed))
+        except ValueError:
+            return random.Random(seed)
+    return random  # the module (duck-typed: exposes .random())
+
+
+def backoff_delays(
+    policy: RetryPolicy, rng: "random.Random | None" = None
+) -> Iterator[float]:
+    """The policy's backoff schedule (one delay per retry, jittered).
+
+    ``rng`` injects the jitter source; without it, ``MOSAIC_RETRY_SEED``
+    makes every schedule identical (see :func:`_jitter_rng`).
+    """
+    r = _jitter_rng(rng)
     delay = policy.base_delay_s
     while True:
-        scale = 1.0 + policy.jitter * (2.0 * random.random() - 1.0)
+        scale = 1.0 + policy.jitter * (2.0 * r.random() - 1.0)
         yield min(delay, policy.max_delay_s) * max(scale, 0.0)
         delay = min(delay * policy.growth, policy.max_delay_s)
 
@@ -77,6 +103,7 @@ def call_with_retry(
     fallback: Callable[[], object] | None = None,
     label: str = "",
     sleep: Callable[[float], None] = _time.sleep,
+    rng: "random.Random | None" = None,
     **kwargs,
 ):
     """Run ``fn(*args, **kwargs)``, retrying transient failures.
@@ -90,7 +117,7 @@ def call_with_retry(
     """
     policy = policy or RetryPolicy.from_env()
     name = label or getattr(fn, "__name__", "call")
-    delays = backoff_delays(policy)
+    delays = backoff_delays(policy, rng=rng)
     t0 = _time.monotonic()
     last: BaseException | None = None
     attempt = 0

@@ -10,12 +10,18 @@ acceptance contract is that resilience is *visible*, never silent.
 from __future__ import annotations
 
 import contextlib
+import itertools
 import threading
 import time
 
 from ..utils import get_logger
 
 _LOCAL = threading.local()
+
+#: process-wide event sequence — ``itertools.count`` increments under the
+#: GIL, so concurrent recorders (watchdog workers, stream threads) still
+#: get unique, strictly increasing numbers
+_SEQ = itertools.count()
 
 
 def _sinks() -> list:
@@ -25,13 +31,38 @@ def _sinks() -> list:
     return sinks
 
 
+def current_sinks() -> list:
+    """This thread's live sink list — hand it to :func:`adopt_sinks` on
+    a worker thread so events recorded there still reach the caller's
+    :func:`capture` scopes (the watchdog does this; list appends are
+    GIL-atomic, so sharing is safe)."""
+    return _sinks()
+
+
+def adopt_sinks(sinks: list) -> None:
+    """Make ``sinks`` (a :func:`current_sinks` result from another
+    thread) this thread's sink list."""
+    _LOCAL.sinks = sinks
+
+
 def record(event: str, **fields) -> dict:
-    """Emit one structured event: ``{"event": event, **fields}``.
+    """Emit one structured event: ``{"event": event, "seq": n,
+    "ts_mono": t, **fields}``.
 
     Fields must be plain JSON-able scalars/dicts so trails can be dumped
-    into bench lines verbatim.
+    into bench lines verbatim. ``seq`` is a per-process strictly
+    increasing sequence number and ``ts_mono`` a monotonic-clock stamp:
+    fault/recovery event streams are thereby TOTALLY ordered — tests
+    assert ordering (a retry precedes its degradation; a snapshot save
+    precedes the resume that reads it) instead of guessing from list
+    position across capture scopes.
     """
-    evt = {"event": event, **fields}
+    evt = {
+        "event": event,
+        "seq": next(_SEQ),
+        "ts_mono": round(time.monotonic(), 6),
+        **fields,
+    }
     for sink in _sinks():
         sink.append(evt)
     get_logger("mosaic_tpu.runtime").info("%s %s", event, fields)

@@ -50,7 +50,11 @@ from ..core.geometry.device import (
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
-from ..runtime import faults as _faults, telemetry as _telemetry
+from ..runtime import (
+    faults as _faults,
+    telemetry as _telemetry,
+    watchdog as _watchdog,
+)
 from ..runtime.errors import DegradedResult, RetryExhausted
 from ..runtime.escalate import run_escalating
 from ..runtime.retry import call_with_retry
@@ -1442,14 +1446,21 @@ def pip_join(
         if not recheck:
 
             def attempt(c):
-                _faults.maybe_fail("pip_join.device")
-                return np.asarray(
-                    _JIT_JOIN(
-                        shifted, cells, chip_index,
-                        heavy_cap=c.get("heavy_cap", hcap),
-                        found_cap=c.get("found_cap", fcap),
-                        writeback=writeback, lookup=lookup,
-                    )
+                # the watchdog guard evaluates the fault hooks
+                # (maybe_fail + planned stalls) on this thread, then runs
+                # the blocking dispatch under its deadline: a hung device
+                # surfaces as a typed StalledDeviceError on the same
+                # retry path as a tunnel drop, never a silent hang
+                return _watchdog.guard(
+                    "pip_join.device",
+                    lambda: np.asarray(
+                        _JIT_JOIN(
+                            shifted, cells, chip_index,
+                            heavy_cap=c.get("heavy_cap", hcap),
+                            found_cap=c.get("found_cap", fcap),
+                            writeback=writeback, lookup=lookup,
+                        )
+                    ),
                 )
 
             out, _ = run_escalating(
@@ -1469,14 +1480,16 @@ def pip_join(
         )
 
         def attempt_banded(c):
-            _faults.maybe_fail("pip_join.device")
-            o, nr = _JIT_JOIN(
-                shifted, cells, chip_index,
-                heavy_cap=c.get("heavy_cap", hcap),
-                found_cap=c.get("found_cap", fcap), edge_eps2=eps2,
-                writeback=writeback, lookup=lookup,
-            )
-            return np.array(o), np.array(nr)  # writable host copies
+            def run_device():
+                o, nr = _JIT_JOIN(
+                    shifted, cells, chip_index,
+                    heavy_cap=c.get("heavy_cap", hcap),
+                    found_cap=c.get("found_cap", fcap), edge_eps2=eps2,
+                    writeback=writeback, lookup=lookup,
+                )
+                return np.array(o), np.array(nr)  # writable host copies
+
+            return _watchdog.guard("pip_join.device", run_device)
 
         (out, host_mask), _ = run_escalating(
             lambda c: call_with_retry(

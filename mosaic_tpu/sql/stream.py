@@ -31,6 +31,24 @@ Three pieces, all CPU-testable and bit-identical to the per-batch path:
 Completion is always forced by :func:`fold_stats` — a device-side
 (checksum, matches, overflow) fold so no per-point data crosses the
 host link inside a measured region.
+
+Durability layer (PR 3): the same stage boundaries that made the ring
+fast make it checkpointable. :meth:`StreamJoin.run_durable` runs the
+scan in segments of ``snapshot_every`` ring cycles, snapshotting the
+scan carry (fold accumulators, ring cursor, prefetched cell ids,
+optional generator key) to a checksummed run directory
+(`runtime/checkpoint.py`) between segments; :meth:`StreamJoin.resume`
+restarts from the last valid snapshot and converges to the SAME final
+(checksum, matches, overflow) as an uninterrupted run — int32 fold
+addition is exact and associative across segment boundaries, and cell
+assignment is deterministic, so segmenting changes scheduling, never
+values (pinned by tests/test_stream_faults.py). Every blocking device
+operation sits under a `runtime/watchdog.py` deadline
+(``MOSAIC_WATCHDOG_*``), transient segment failures retry and then
+degrade to the f64 host oracle (surfaced as ``metrics["degraded"]``,
+never vanishing into the fold), and :meth:`StreamJoin.admit` diverts
+poisoned input rows (NaN/Inf, out-of-CRS-bounds) into a quarantine
+buffer (`runtime/quarantine.py`) instead of the device fold.
 """
 
 from __future__ import annotations
@@ -43,8 +61,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..runtime import telemetry as _telemetry
-from .join import ChipIndex, pip_join_points
+from ..runtime import (
+    checkpoint as _checkpoint,
+    faults as _faults,
+    quarantine as _quarantine,
+    telemetry as _telemetry,
+    watchdog as _watchdog,
+)
+from ..runtime.errors import RetryExhausted
+from ..runtime.retry import call_with_retry
+from .join import ChipIndex, host_join_with_cells, pip_join_points
 
 
 def fold_stats(out: jax.Array) -> jax.Array:
@@ -62,11 +88,19 @@ def fold_stats(out: jax.Array) -> jax.Array:
 
 def ring_from_host(batches) -> jax.Array:
     """Stack host point batches into one (K, B, 2) f64 device-resident
-    ring. Blocks until the ring is staged (staging is not loop time)."""
+    ring. Blocks until the ring is staged (staging is not loop time).
+    ``stream.prefetch`` is the fault/watchdog site: staging the next
+    inputs is where a tunnel drop or hang surfaces in ring rebuilds."""
     with _telemetry.timed("stream_stage", stage="ring_build", source="host"):
-        ring = jnp.stack([jnp.asarray(b, dtype=jnp.float64) for b in batches])
-        ring.block_until_ready()
-    return ring
+
+        def stage():
+            ring = jnp.stack(
+                [jnp.asarray(b, dtype=jnp.float64) for b in batches]
+            )
+            ring.block_until_ready()
+            return ring
+
+        return _watchdog.guard("stream.prefetch", stage)
 
 
 def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
@@ -75,11 +109,15 @@ def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
     with _telemetry.timed(
         "stream_stage", stage="ring_build", source="device_gen", k=k
     ):
-        ring = jnp.stack(
-            [gen(jax.random.fold_in(key, i)) for i in range(k)]
-        )
-        ring.block_until_ready()
-    return ring
+
+        def stage():
+            ring = jnp.stack(
+                [gen(jax.random.fold_in(key, i)) for i in range(k)]
+            )
+            ring.block_until_ready()
+            return ring
+
+        return _watchdog.guard("stream.prefetch", stage)
 
 
 def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
@@ -115,7 +153,15 @@ def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
 
 @dataclasses.dataclass
 class StreamResult:
-    """One streamed run: device-fold stats + wall-clock accounting."""
+    """One streamed run: device-fold stats + wall-clock accounting.
+
+    ``metrics`` is the durability/quality side channel: ``degraded``
+    (any segment answered by the host oracle), ``degraded_segments``,
+    ``snapshots`` written, ``resumed_from`` (ring cursor a resume
+    started at, else None), and the quarantine counters when admission
+    ran (``quarantined``, ``quarantine_reasons``). Plain runs carry an
+    empty dict — absence of a key is never a signal.
+    """
 
     checksum: int
     matches: int
@@ -127,6 +173,7 @@ class StreamResult:
     points_per_sec: float
     prefetch: bool
     outs: np.ndarray | None = None  # (nb, B) per-batch rows (collect=True)
+    metrics: dict = dataclasses.field(default_factory=dict)
 
 
 class StreamJoin:
@@ -156,7 +203,11 @@ class StreamJoin:
         prefetch: bool = True,
     ):
         self.index = index
+        self.index_system = index_system
+        self.resolution = resolution
         self.prefetch = bool(prefetch)
+        #: (ring fingerprint, report) of the last admission, if any
+        self._last_quarantine: tuple | None = None
         dtype = index.border.verts.dtype
         platform = jax.devices()[0].platform
         if lookup is None:
@@ -175,6 +226,10 @@ class StreamJoin:
                 pts.astype(cell_dtype), resolution
             )
             return c.astype(jnp.int64)
+
+        # eager twin for tiny host-side lookups (park-point search): a
+        # jitted call would recompile the whole cell pipeline per shape
+        self._assign_eager = assign
 
         def join(pts, cells, chip_index):
             shifted = (pts - chip_index.border.shift).astype(dtype)
@@ -236,6 +291,43 @@ class StreamJoin:
             return acc, outs
 
         self._loop = jax.jit(loop, static_argnames=("nb", "collect"))
+
+        def seg(ring, chip_index, i0, acc, cells, nb: int, collect: bool):
+            """One durable segment: the SAME scan body as ``loop`` over
+            absolute batch indices [i0, i0+nb). The carry crosses
+            segments through the host (snapshot), so the fold stays
+            int32-add-exact and cell prefetch deterministic — segmenting
+            is invisible in the final stats."""
+            k = ring.shape[0]
+
+            def slot(i):
+                return jax.lax.dynamic_index_in_dim(
+                    ring, i % k, axis=0, keepdims=False
+                )
+
+            steps = i0 + jnp.arange(nb, dtype=jnp.int32)
+            if self.prefetch:
+
+                def body(carry, i):
+                    a, cells_cur = carry
+                    out = join(slot(i), cells_cur, chip_index)
+                    cells_next = assign(slot(i + 1))
+                    return (a + fold_stats(out), cells_next), (
+                        out if collect else None
+                    )
+
+                (acc, cells), outs = jax.lax.scan(body, (acc, cells), steps)
+            else:
+
+                def body(a, i):
+                    pts = slot(i)
+                    out = join(pts, assign(pts), chip_index)
+                    return a + fold_stats(out), (out if collect else None)
+
+                acc, outs = jax.lax.scan(body, acc, steps)
+            return acc, cells, outs
+
+        self._seg_loop = jax.jit(seg, static_argnames=("nb", "collect"))
 
     def step(self, pts: jax.Array) -> jax.Array:
         """Single fused batch (assign + join) — the single-batch-rate
@@ -320,6 +412,374 @@ class StreamJoin:
             prefetch=False,
             outs=np.stack(outs),
         )
+
+    # ------------------------------------------------------ durability
+
+    def admit(
+        self,
+        batches,
+        *,
+        bounds: tuple | None = None,
+        park: np.ndarray | None = None,
+    ) -> "tuple[jax.Array, _quarantine.QuarantineReport]":
+        """Validate and stage host batches into a ring; poisoned rows go
+        to quarantine, never to the device fold.
+
+        Each batch is scrubbed (`runtime/quarantine.py`): non-finite
+        rows, and rows outside ``bounds`` (xmin, ymin, xmax, ymax) when
+        given, are recorded in the returned
+        :class:`~mosaic_tpu.runtime.quarantine.QuarantineReport` (their
+        raw values land in ``report.buffer`` for triage) and replaced in
+        the staged ring by the stream's *park point* — a coordinate
+        proven here to hit no indexed cell, so every parked row returns
+        -1 and contributes exactly zero to each fold statistic. Admitted
+        rows are staged bit-identically; the ring is otherwise exactly
+        :func:`ring_from_host`'s. The report's counters surface in
+        ``metrics`` of subsequent :meth:`run_durable` calls.
+        """
+        raws = [
+            np.asarray(
+                _faults.maybe_corrupt("stream.admit", b), dtype=np.float64
+            )
+            for b in batches
+        ]
+        report = _quarantine.QuarantineReport()
+        park_pt = (
+            None if park is None else np.asarray(park, dtype=np.float64)
+        )
+        cleaned = []
+        for bi, raw in enumerate(raws):
+            bad, reasons = _quarantine.scrub_points(raw, bounds=bounds)
+            report.merge_batch(bi, raw, bad, reasons)
+            if bad.any():
+                if park_pt is None:
+                    park_pt = self._find_park(raws, bounds)
+                clean = raw.copy()
+                clean[bad] = park_pt
+                cleaned.append(clean)
+            else:
+                cleaned.append(raw)
+        ring = ring_from_host(cleaned)
+        if report.n_quarantined:
+            _telemetry.record("stream_quarantine", **report.metrics())
+        # keyed by ring fingerprint: run_durable only surfaces this
+        # report for the ring THIS admission staged, never a stale one
+        self._last_quarantine = (_checkpoint.fingerprint(ring), report)
+        return ring, report
+
+    def _find_park(self, raws, bounds) -> np.ndarray:
+        """The guaranteed-miss park coordinate (see ``admit``)."""
+        if bounds is None:
+            finite = [r[np.isfinite(r).all(axis=1)] for r in raws]
+            finite = [f for f in finite if f.size]
+            allp = (
+                np.concatenate(finite)
+                if finite
+                else np.zeros((1, 2), np.float64)
+            )
+            bounds = (
+                float(allp[:, 0].min()), float(allp[:, 1].min()),
+                float(allp[:, 0].max()), float(allp[:, 1].max()),
+            )
+        return _quarantine.find_park_point(
+            lambda p: self._assign_eager(jnp.asarray(p, jnp.float64)),
+            np.asarray(self.index.cells),
+            bounds,
+        )
+
+    def _host_segment(self, ring_np, i0: int, nb: int, collect: bool):
+        """f64 host-oracle evaluation of batches [i0, i0+nb) — the
+        degradation fallback when a segment's device path fails past the
+        retry budget. Returns ((3,) int64 fold delta, outs | None)."""
+        host = self.index.host
+        k = ring_np.shape[0]
+        acc = np.zeros(3, np.int64)
+        outs = []
+        for i in range(i0, i0 + nb):
+            pts = np.asarray(ring_np[i % k], np.float64)
+            cells = np.asarray(
+                self.index_system.point_to_cell(pts, self.resolution)
+            )
+            out = host_join_with_cells(pts, cells, host)
+            acc += fold_stats_np(out)
+            if collect:
+                outs.append(out)
+        return acc, (np.stack(outs) if collect else None)
+
+    def run_durable(
+        self,
+        ring: jax.Array,
+        n_batches: int,
+        *,
+        run_dir: str,
+        snapshot_every: int = 8,
+        collect: bool = False,
+        extra_arrays: dict | None = None,
+        watchdog_default_s: float = 600.0,
+        retry_policy: "RetryPolicy | None" = None,
+    ) -> StreamResult:
+        """A streamed pass that survives device loss: the scan runs in
+        segments of ``snapshot_every`` ring cycles, persisting the scan
+        carry (fold accumulators, ring cursor, prefetched cell ids, any
+        ``extra_arrays`` such as the generator key) to ``run_dir`` after
+        each segment (`runtime/checkpoint.py`: checksummed, atomic).
+
+        Identical final (checksum, matches, overflow) to :meth:`run` —
+        int32 fold addition segments exactly, cell prefetch is
+        deterministic. Each segment dispatch sits under the
+        ``stream.scan_step`` watchdog deadline and the transient-retry
+        budget; past the budget the segment degrades to the f64 host
+        oracle and ``metrics["degraded"]`` reports it. Snapshot failures
+        never kill the run (``snapshot_skipped`` telemetry; resume
+        granularity coarsens). Interrupt anywhere and
+        :meth:`resume`\\ (``run_dir``, same ring) finishes the run.
+        """
+        return self._run_segments(
+            ring, int(n_batches), run_dir=run_dir,
+            snapshot_every=int(snapshot_every), start_step=0,
+            acc0=None, cells0=None, collect=collect,
+            resumed_from=None, extra_arrays=extra_arrays,
+            watchdog_default_s=watchdog_default_s,
+            retry_policy=retry_policy,
+        )
+
+    def resume(
+        self,
+        run_dir: str,
+        ring: jax.Array,
+        *,
+        collect: bool = False,
+        watchdog_default_s: float = 600.0,
+        retry_policy: "RetryPolicy | None" = None,
+    ) -> StreamResult:
+        """Restart an interrupted :meth:`run_durable` from the last
+        VALID snapshot in ``run_dir`` (corrupt/truncated snapshots are
+        skipped with telemetry) and run to completion.
+
+        The snapshot's ring fingerprint, shape, and prefetch mode must
+        match this stream — resuming against different data would
+        silently fold garbage. Converges to the same final (checksum,
+        matches, overflow) as the uninterrupted run; ``metrics
+        ["resumed_from"]`` records the ring cursor resumed at. With
+        ``collect=True``, ``outs`` covers only the batches run by THIS
+        call (earlier rows are already folded into the snapshot).
+        """
+        loaded = _checkpoint.load_latest(run_dir)
+        if loaded is None:
+            raise FileNotFoundError(
+                f"no valid snapshot under {run_dir!r} — nothing to resume"
+            )
+        step, arrays, meta = loaded
+        k, batch = int(ring.shape[0]), int(ring.shape[1])
+        if bool(meta.get("prefetch")) != self.prefetch:
+            raise ValueError(
+                f"snapshot prefetch={meta.get('prefetch')} != stream "
+                f"prefetch={self.prefetch}"
+            )
+        if int(meta.get("ring_k", k)) != k or int(
+            meta.get("batch", batch)
+        ) != batch:
+            raise ValueError(
+                f"snapshot ring shape ({meta.get('ring_k')}, "
+                f"{meta.get('batch')}) != resumed ring ({k}, {batch})"
+            )
+        want_fp = meta.get("ring_sha256")
+        if want_fp and want_fp != _checkpoint.fingerprint(ring):
+            raise ValueError(
+                "snapshot ring fingerprint mismatch — this is not the "
+                "ring the interrupted run was folding"
+            )
+        cells0 = (
+            jnp.asarray(arrays["cells"]) if "cells" in arrays else None
+        )
+        return self._run_segments(
+            ring, int(meta["n_batches"]), run_dir=run_dir,
+            snapshot_every=int(meta.get("snapshot_every", 8)),
+            start_step=int(step),
+            acc0=np.asarray(arrays["acc"], np.int64),
+            cells0=cells0, collect=collect, resumed_from=int(step),
+            extra_arrays={
+                key[2:]: val
+                for key, val in arrays.items()
+                if key.startswith("x_")
+            } or None,
+            watchdog_default_s=watchdog_default_s,
+            retry_policy=retry_policy,
+        )
+
+    def _run_segments(
+        self, ring, n_batches, *, run_dir, snapshot_every, start_step,
+        acc0, cells0, collect, resumed_from, extra_arrays,
+        watchdog_default_s, retry_policy,
+    ) -> StreamResult:
+        k, batch = int(ring.shape[0]), int(ring.shape[1])
+        snapshot_every = max(1, snapshot_every)
+        ring_np = np.asarray(ring)  # host twin: fingerprint + fallback
+        ring_fp = _checkpoint.fingerprint(ring_np)
+        acc = (
+            np.zeros(3, np.int64) if acc0 is None
+            else _wrap_i32(np.asarray(acc0, np.int64))
+        )
+        if self.prefetch:
+            cells = (
+                cells0 if cells0 is not None
+                else self.assign(ring[start_step % k])
+            )
+        else:
+            cells = jnp.zeros((0,), jnp.int64)  # inert placeholder carry
+        meta = {
+            "n_batches": int(n_batches),
+            "batch": batch,
+            "ring_k": k,
+            "prefetch": self.prefetch,
+            "snapshot_every": int(snapshot_every),
+            "ring_sha256": ring_fp,
+        }
+        degraded_segments = 0
+        snapshots = 0
+        outs_list: list[np.ndarray] = []
+        host = getattr(self.index, "host", None)
+        step = start_step
+        t0 = time.perf_counter()
+        while step < n_batches:
+            seg_n = min(snapshot_every, n_batches - step)
+            acc_i32 = jnp.asarray(_wrap_i32(acc).astype(np.int32))
+            cells_arg = cells
+
+            def dispatch():
+                a, c, o = self._seg_loop(
+                    ring, self.index, jnp.int32(step), acc_i32,
+                    cells_arg, nb=seg_n, collect=collect,
+                )
+                # one host pull forces completion (and is what a real
+                # stall would block on)
+                return (
+                    np.asarray(a), c,
+                    np.asarray(o) if collect else None,
+                )
+
+            try:
+                a_np, cells_new, o_np = call_with_retry(
+                    lambda: _watchdog.guard(
+                        "stream.scan_step", dispatch,
+                        default_s=watchdog_default_s,
+                    ),
+                    policy=retry_policy,
+                    label="stream.scan_step",
+                )
+                acc = np.asarray(a_np, np.int64)
+                cells = cells_new
+            except RetryExhausted as e:
+                if host is None:
+                    raise
+                _telemetry.record(
+                    "degraded", label="stream.scan_step", step=step,
+                    attempts=e.attempts, error=repr(e.last)[:200],
+                )
+                delta, o_np = self._host_segment(
+                    ring_np, step, seg_n, collect
+                )
+                acc = _wrap_i32(acc + delta)
+                degraded_segments += 1
+                if self.prefetch:
+                    cells = self.assign(ring[(step + seg_n) % k])
+            if collect and o_np is not None:
+                outs_list.append(o_np)
+            step += seg_n
+
+            def snap():
+                payload = {"acc": _wrap_i32(acc).astype(np.int32)}
+                if self.prefetch:
+                    payload["cells"] = np.asarray(cells)  # snapshot D2H
+                for key, val in (extra_arrays or {}).items():
+                    payload[f"x_{key}"] = np.asarray(val)
+                return _checkpoint.save_snapshot(
+                    run_dir, step, payload, meta
+                )
+
+            try:
+                call_with_retry(
+                    lambda: _watchdog.guard(
+                        "stream.snapshot", snap,
+                        default_s=watchdog_default_s,
+                    ),
+                    policy=retry_policy,
+                    label="stream.snapshot",
+                )
+                snapshots += 1
+            except RetryExhausted as e:
+                # durability degrades (coarser resume point), the run
+                # itself must not die for a sick disk
+                _telemetry.record(
+                    "snapshot_skipped", run_dir=run_dir, step=step,
+                    error=repr(e.last)[:200],
+                )
+        wall = time.perf_counter() - t0
+        acc_w = _wrap_i32(acc)
+        n_run = n_batches - start_step
+        n_points = n_batches * batch
+        _telemetry.record(
+            "stream_stage", stage="durable_loop",
+            seconds=round(wall, 6), n_batches=n_batches,
+            batch=batch, ring_k=k, prefetch=self.prefetch,
+            snapshots=snapshots, degraded_segments=degraded_segments,
+            resumed_from=resumed_from,
+            points_per_sec=round(
+                n_run * batch / max(wall, 1e-9), 1
+            ),
+        )
+        metrics = {
+            "degraded": degraded_segments > 0,
+            "degraded_segments": degraded_segments,
+            "snapshots": snapshots,
+            "resumed_from": resumed_from,
+            "run_dir": run_dir,
+        }
+        if (
+            self._last_quarantine is not None
+            and self._last_quarantine[0] == ring_fp
+        ):
+            metrics.update(self._last_quarantine[1].metrics())
+        return StreamResult(
+            checksum=int(acc_w[0]),
+            matches=int(acc_w[1]),
+            overflow=int(acc_w[2]),
+            n_points=n_points,
+            n_batches=n_batches,
+            batch=batch,
+            wall_s=wall,
+            points_per_sec=n_run * batch / max(wall, 1e-9),
+            prefetch=self.prefetch,
+            outs=(
+                np.concatenate(outs_list)
+                if collect and outs_list
+                else None
+            ),
+            metrics=metrics,
+        )
+
+
+def _wrap_i32(v: np.ndarray) -> np.ndarray:
+    """int64 -> the int32 two's-complement value (the device fold's
+    wraparound semantics, applied on host so segment accumulation stays
+    bit-identical to one uninterrupted int32 scan)."""
+    return (
+        (np.asarray(v, np.int64) + (1 << 31)) % (1 << 32) - (1 << 31)
+    ).astype(np.int64)
+
+
+def fold_stats_np(out: np.ndarray) -> np.ndarray:
+    """(3,) int64 host twin of :func:`fold_stats` (checksum term exact
+    mod 2^32; wrap with :func:`_wrap_i32` after accumulating)."""
+    o = np.asarray(out, np.int32)
+    return np.array(
+        [
+            int((o ^ (o >> 16)).astype(np.int64).sum()),
+            int((o >= 0).sum()),
+            int((o == -2).sum()),
+        ],
+        dtype=np.int64,
+    )
 
 
 def generator_rate(

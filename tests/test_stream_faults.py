@@ -1,0 +1,424 @@
+"""Durable streaming contracts (ISSUE 3): checkpoint/resume, watchdog,
+poisoned-input quarantine, and stream-aware fault injection.
+
+The acceptance criteria pinned here, all on CPU:
+
+1. **kill-and-resume ≡ clean run, bit-identically** — interrupting a
+   `StreamJoin.run_durable` after ANY snapshot boundary and resuming
+   from the run directory yields the exact final (checksum, matches,
+   overflow) of an uninterrupted run, under every injected fault plan
+   (fatal kill, transient errors, corrupt snapshot on disk).
+2. **quarantine exactness** — injected NaN/Inf/out-of-bounds rows
+   appear exactly (and only) in the quarantine report; admitted-row
+   results are bit-identical to the clean ring's rows, and the final
+   fold equals the clean fold with the poison rows' contributions
+   removed (parked rows contribute exactly zero).
+3. **watchdog** — an injected stall becomes a typed
+   `StalledDeviceError` that the retry layer recovers within budget:
+   no hang, no silent partial stats.
+4. **degradation visibility** — a segment that exhausts its retry
+   budget answers from the f64 host oracle and surfaces
+   ``metrics["degraded"]`` at the stream level (satellite: never
+   vanishing into the fold).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mosaic_tpu.core.geometry import wkt
+from mosaic_tpu.core.index import CustomIndexSystem, GridConf
+from mosaic_tpu.core.tessellate import tessellate
+from mosaic_tpu.runtime import (
+    RetryPolicy,
+    StalledDeviceError,
+    TransientDeviceError,
+    backoff_delays,
+    checkpoint,
+    faults,
+    is_transient,
+    quarantine,
+    telemetry,
+    watchdog,
+)
+from mosaic_tpu.sql.join import build_chip_index
+from mosaic_tpu.sql.stream import StreamJoin, fold_stats_np, ring_from_host
+
+CUSTOM = CustomIndexSystem(GridConf(-180, 180, -90, 90, 2, 10.0, 10.0))
+RES = 3
+ZONES = [
+    "POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1), "
+    "(5 5, 5 8, 8 8, 8 5, 5 5))",
+    "POLYGON ((20 0, 30 0, 30 10, 25 4, 20 10, 20 0))",
+]
+K, BATCH, NB = 3, 1024, 7
+SNAP = 2  # snapshot every 2 ring cycles -> boundaries at 2, 4, 6, 7
+BOUNDS = (-25.0, -25.0, 35.0, 20.0)
+FAST = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+
+
+@pytest.fixture(scope="module")
+def index():
+    col = wkt.from_wkt(ZONES)
+    return build_chip_index(
+        tessellate(col, CUSTOM, RES, keep_core_geoms=False)
+    )
+
+
+@pytest.fixture(scope="module")
+def batches():
+    rng = np.random.default_rng(7)
+    return [
+        rng.uniform(BOUNDS[:2], BOUNDS[2:], (BATCH, 2)) for _ in range(K)
+    ]
+
+
+@pytest.fixture(scope="module")
+def ring(batches):
+    return ring_from_host(batches)
+
+
+@pytest.fixture(scope="module")
+def sj(index):
+    return StreamJoin(index, CUSTOM, RES, prefetch=True)
+
+
+@pytest.fixture(scope="module")
+def clean(sj, ring):
+    return sj.run(ring, NB, collect=True)
+
+
+def _stats(r):
+    return (r.checksum, r.matches, r.overflow)
+
+
+# ------------------------------------------------------------ checkpoint
+
+
+def test_durable_run_equals_plain_run(sj, ring, clean, tmp_path):
+    r = sj.run_durable(
+        ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+        retry_policy=FAST,
+    )
+    assert _stats(r) == _stats(clean)
+    assert r.metrics["degraded"] is False
+    assert r.metrics["snapshots"] == 4  # boundaries 2, 4, 6, 7
+    assert checkpoint.list_snapshots(str(tmp_path)) == [2, 4, 6, 7]
+
+
+def test_durable_non_prefetch_equals_plain_run(index, ring, clean, tmp_path):
+    sj0 = StreamJoin(index, CUSTOM, RES, prefetch=False)
+    r = sj0.run_durable(
+        ring, NB, run_dir=str(tmp_path), snapshot_every=3,
+        retry_policy=FAST,
+    )
+    assert _stats(r) == _stats(clean)
+
+
+@pytest.mark.parametrize("kill_after", [1, 2, 3])
+def test_kill_and_resume_bit_identical(sj, ring, clean, tmp_path, kill_after):
+    """A fatal (non-transient) device loss after ``kill_after`` segments
+    aborts the run; resume() from the last snapshot converges to the
+    clean run's exact final stats."""
+    d = str(tmp_path / f"kill{kill_after}")
+    with faults.inject(
+        fail_first=99, skip_first=kill_after,
+        sites=("stream.scan_step",),
+        exc_factory=lambda s: RuntimeError(f"simulated device loss @ {s}"),
+    ):
+        with pytest.raises(RuntimeError, match="simulated device loss"):
+            sj.run_durable(
+                ring, NB, run_dir=d, snapshot_every=SNAP,
+                retry_policy=FAST,
+            )
+    assert checkpoint.list_snapshots(d)  # at least one boundary persisted
+    r = sj.resume(d, ring, retry_policy=FAST)
+    assert _stats(r) == _stats(clean)
+    assert r.metrics["resumed_from"] == kill_after * SNAP
+
+
+def test_resume_skips_corrupt_snapshot(sj, ring, clean, tmp_path):
+    """Bit rot / a kill mid-write on the NEWEST snapshot must fall back
+    to the previous valid boundary, not fail the resume."""
+    d = str(tmp_path)
+    with faults.inject(
+        fail_first=99, skip_first=2, sites=("stream.scan_step",),
+        exc_factory=lambda s: RuntimeError("simulated device loss"),
+    ):
+        with pytest.raises(RuntimeError):
+            sj.run_durable(
+                ring, NB, run_dir=d, snapshot_every=SNAP,
+                retry_policy=FAST,
+            )
+    steps = checkpoint.list_snapshots(d)
+    assert steps == [2, 4]
+    # truncate the newest npz: its sidecar hash no longer matches
+    with open(os.path.join(d, "snap-00000004.npz"), "r+b") as f:
+        f.truncate(64)
+    with telemetry.capture() as ev:
+        r = sj.resume(d, ring, retry_policy=FAST)
+    assert _stats(r) == _stats(clean)
+    assert r.metrics["resumed_from"] == 2
+    kinds = [e["event"] for e in ev]
+    assert "snapshot_corrupt_skipped" in kinds
+    assert "snapshot_resumed" in kinds
+
+
+def test_resume_rejects_wrong_ring(sj, ring, tmp_path):
+    d = str(tmp_path)
+    sj.run_durable(
+        ring, NB, run_dir=d, snapshot_every=SNAP, retry_policy=FAST
+    )
+    other = jnp.asarray(np.asarray(ring) + 1.0)
+    with pytest.raises(ValueError, match="fingerprint"):
+        sj.resume(d, other, retry_policy=FAST)
+
+
+def test_resume_without_snapshots_raises(sj, ring, tmp_path):
+    with pytest.raises(FileNotFoundError):
+        sj.resume(str(tmp_path / "empty"), ring)
+
+
+def test_snapshot_atomicity_and_checksum_roundtrip(tmp_path):
+    d = str(tmp_path)
+    arrays = {"acc": np.arange(3, dtype=np.int32), "cells": np.arange(8)}
+    checkpoint.save_snapshot(d, 5, arrays, {"n_batches": 9})
+    loaded = checkpoint.load_latest(d)
+    assert loaded is not None
+    step, arrs, meta = loaded
+    assert step == 5 and meta["n_batches"] == 9
+    np.testing.assert_array_equal(arrs["acc"], arrays["acc"])
+    np.testing.assert_array_equal(arrs["cells"], arrays["cells"])
+    assert not [p for p in os.listdir(d) if p.endswith(".tmp")]
+
+
+# --------------------------------------------------- transient + degraded
+
+
+def test_transient_scan_faults_retry_to_clean(sj, ring, clean, tmp_path):
+    with telemetry.capture() as ev:
+        with faults.transient_errors(2, sites=("stream.scan_step",)):
+            r = sj.run_durable(
+                ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                retry_policy=FAST,
+            )
+    assert _stats(r) == _stats(clean)
+    assert r.metrics["degraded"] is False
+    assert [e["event"] for e in ev].count("transient_retry") == 2
+
+
+def test_exhausted_segment_degrades_to_host_oracle(sj, ring, clean, tmp_path):
+    """Satellite: DegradedResult-style degradation must surface in the
+    STREAM metrics, never vanish into the fold. The degraded segment is
+    answered by the f64 host oracle; on this fixture the oracle agrees
+    with the device bit-for-bit, so the final stats still equal clean."""
+    with telemetry.capture() as ev:
+        with faults.transient_errors(
+            3, sites=("stream.scan_step",)
+        ):  # == FAST.max_attempts: the first segment's budget exhausts
+            r = sj.run_durable(
+                ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                retry_policy=FAST,
+            )
+    assert r.metrics["degraded"] is True
+    assert r.metrics["degraded_segments"] == 1
+    assert _stats(r) == _stats(clean)
+    kinds = [e["event"] for e in ev]
+    assert "degraded" in kinds
+
+
+def test_snapshot_failure_does_not_kill_run(sj, ring, clean, tmp_path):
+    """A sick disk (every snapshot write failing) coarsens durability,
+    but the stream still converges with the snapshot_skipped trail."""
+    with telemetry.capture() as ev:
+        with faults.transient_errors(999, sites=("stream.snapshot",)):
+            r = sj.run_durable(
+                ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                retry_policy=FAST,
+            )
+    assert _stats(r) == _stats(clean)
+    assert r.metrics["snapshots"] == 0
+    assert [e["event"] for e in ev].count("snapshot_skipped") == 4
+
+
+# -------------------------------------------------------------- watchdog
+
+
+def test_watchdog_guard_raises_typed_stall(monkeypatch):
+    monkeypatch.setenv("MOSAIC_WATCHDOG_UNIT_SITE", "0.05")
+    with telemetry.capture() as ev:
+        with pytest.raises(StalledDeviceError) as ei:
+            with faults.stalls(0.5, sites=("unit.site",)):
+                watchdog.guard("unit.site", lambda: 42)
+    assert ei.value.site == "unit.site"
+    assert ei.value.deadline_s == pytest.approx(0.05)
+    assert is_transient(ei.value)  # stalls ride the retry path
+    assert isinstance(ei.value, TransientDeviceError)
+    assert any(e["event"] == "watchdog_stall" for e in ev)
+
+
+def test_watchdog_inline_when_disabled(monkeypatch):
+    monkeypatch.delenv("MOSAIC_WATCHDOG_S", raising=False)
+    assert watchdog.guard("no.deadline", lambda: 7) == 7
+    assert watchdog.deadline_for("no.deadline") is None
+    monkeypatch.setenv("MOSAIC_WATCHDOG_S", "3.5")
+    assert watchdog.deadline_for("any.site") == 3.5
+    monkeypatch.setenv("MOSAIC_WATCHDOG_ANY_SITE", "0")  # 0 disables
+    assert watchdog.deadline_for("any.site") is None
+
+
+def test_watchdog_stall_recovered_by_retry(sj, ring, clean, tmp_path,
+                                           monkeypatch):
+    """Acceptance: an injected mid-stream stall becomes a typed
+    StalledDeviceError the retry layer recovers — the run completes with
+    full, exact stats and the stall is visible in telemetry."""
+    monkeypatch.setenv("MOSAIC_WATCHDOG_STREAM_SCAN_STEP", "0.15")
+    with telemetry.capture() as ev:
+        with faults.stalls(1.2, n=1, sites=("stream.scan_step",)):
+            r = sj.run_durable(
+                ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                retry_policy=FAST,
+            )
+    assert _stats(r) == _stats(clean)
+    assert r.metrics["degraded"] is False
+    kinds = [e["event"] for e in ev]
+    assert "fault_stall_injected" in kinds
+    assert "watchdog_stall" in kinds
+    assert "transient_retry" in kinds
+
+
+# ------------------------------------------------------------ quarantine
+
+
+def test_quarantine_exact_poison_set(sj, batches, clean):
+    """Injected poison rows appear exactly (and only) in the quarantine;
+    admitted rows' results are bit-identical to the clean ring's, and
+    the final fold equals the clean fold minus the poison rows'
+    contributions (parked rows contribute exactly zero)."""
+    poisoned = [b.copy() for b in batches]
+    poison = [(0, 3), (1, 5), (1, 6), (2, 100)]
+    for bi, row in poison[:3]:
+        poisoned[bi][row] = np.nan
+    poisoned[2][100] = (1e6, 1e6)  # finite but far out of CRS bounds
+    with telemetry.capture() as ev:
+        ring_q, rep = sj.admit(poisoned, bounds=BOUNDS)
+    assert rep.n_quarantined == 4
+    assert sorted(rep.rows) == sorted(poison)
+    assert rep.reasons["nonfinite"] == 3
+    assert rep.reasons["out_of_bounds"] == 1
+    assert rep.buffer.shape == (4, 2)
+    assert any(e["event"] == "stream_quarantine" for e in ev)
+
+    r = sj.run(ring_q, NB, collect=True)
+    # admitted rows row-for-row identical to the clean ring's results
+    mask = np.zeros((NB, BATCH), dtype=bool)
+    for i in range(NB):
+        for bi, row in poison:
+            if i % K == bi:
+                mask[i, row] = True
+    np.testing.assert_array_equal(r.outs[~mask], clean.outs[~mask])
+    # parked rows miss: exactly -1, zero fold contribution
+    assert (r.outs[mask] == -1).all()
+    want = fold_stats_np(np.where(mask, -1, clean.outs))
+    assert (r.checksum & 0xFFFFFFFF) == (int(want[0]) & 0xFFFFFFFF)
+    assert r.matches == int(want[1]) and r.overflow == int(want[2])
+
+
+def test_quarantine_via_fault_injection(sj, batches):
+    """faults.corrupt_batches poisons admission inputs; the quarantine
+    must catch exactly the corrupted rows and never mutate the caller's
+    arrays."""
+    originals = [b.copy() for b in batches]
+    with faults.corrupt_batches(rows=4, n=1, sites=("stream.admit",)):
+        ring_q, rep = sj.admit(batches, bounds=BOUNDS)
+    for b, o in zip(batches, originals):
+        np.testing.assert_array_equal(b, o)  # inputs untouched
+    assert rep.n_quarantined == 4
+    assert rep.rows == [(0, 0), (0, 1), (0, 2), (0, 3)]
+    assert rep.reasons["nonfinite"] == 4
+
+
+def test_quarantine_metrics_surface_in_durable_run(sj, batches, tmp_path):
+    poisoned = [b.copy() for b in batches]
+    poisoned[0][0] = np.inf
+    ring_q, rep = sj.admit(poisoned, bounds=BOUNDS)
+    r = sj.run_durable(
+        ring_q, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+        retry_policy=FAST,
+    )
+    assert r.metrics["quarantined"] == 1
+    assert r.metrics["quarantine_reasons"] == {"nonfinite": 1}
+
+
+def test_clean_admission_is_bit_identical_to_ring_from_host(sj, batches,
+                                                            ring):
+    ring_a, rep = sj.admit(batches, bounds=BOUNDS)
+    assert rep.n_quarantined == 0
+    np.testing.assert_array_equal(np.asarray(ring_a), np.asarray(ring))
+
+
+def test_degenerate_zone_mask_host_oracle():
+    col = wkt.from_wkt([
+        ZONES[0],                                      # healthy
+        "POLYGON ((0 0, 2 2, 2 0, 0 2, 0 0))",         # bowtie
+        "POLYGON ((0 0, 1 1, 2 2, 0 0))",              # zero area
+        "POINT (3 3)",                                 # non-polygon: pass
+    ])
+    mask, reasons = quarantine.degenerate_zone_mask(col)
+    np.testing.assert_array_equal(mask, [False, True, True, False])
+    assert reasons["self_intersecting"] == 1
+    assert reasons["tiny_area"] == 1
+
+
+# ----------------------------------------------- telemetry + retry seeds
+
+
+def test_telemetry_events_totally_ordered(sj, ring, tmp_path):
+    with telemetry.capture() as ev:
+        sj.run_durable(
+            ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+            retry_policy=FAST,
+        )
+    assert len(ev) >= 5
+    seqs = [e["seq"] for e in ev]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    ts = [e["ts_mono"] for e in ev]
+    assert all(b >= a for a, b in zip(ts, ts[1:]))
+
+
+def test_snapshot_precedes_resume_in_event_order(sj, ring, clean, tmp_path):
+    d = str(tmp_path)
+    with telemetry.capture() as ev:
+        with faults.inject(
+            fail_first=9, skip_first=1, sites=("stream.scan_step",),
+            exc_factory=lambda s: RuntimeError("kill"),
+        ):
+            with pytest.raises(RuntimeError):
+                sj.run_durable(
+                    ring, NB, run_dir=d, snapshot_every=SNAP,
+                    retry_policy=FAST,
+                )
+        sj.resume(d, ring, retry_policy=FAST)
+    saved = [e["seq"] for e in ev if e["event"] == "snapshot_saved"]
+    resumed = [e["seq"] for e in ev if e["event"] == "snapshot_resumed"]
+    assert saved and resumed
+    assert min(resumed) > saved[0]  # the resume reads an earlier save
+
+
+def test_backoff_jitter_deterministic_under_seed(monkeypatch):
+    pol = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, jitter=0.5)
+    monkeypatch.setenv("MOSAIC_RETRY_SEED", "1234")
+    a = [next(backoff_delays(pol)) for _ in range(1)]
+    d1 = backoff_delays(pol)
+    d2 = backoff_delays(pol)
+    assert [next(d1) for _ in range(5)] == [next(d2) for _ in range(5)]
+    monkeypatch.delenv("MOSAIC_RETRY_SEED")
+    import random as _random
+
+    d3 = backoff_delays(pol, rng=_random.Random(9))
+    d4 = backoff_delays(pol, rng=_random.Random(9))
+    assert [next(d3) for _ in range(5)] == [next(d4) for _ in range(5)]
+    assert a  # seeded env path produced a value at all

@@ -66,6 +66,20 @@ def main() -> None:
                     help="also run the r05-style gen-in-loop stream")
     ap.add_argument("--verify", action="store_true",
                     help="assert stream == per-batch bit-identity (CPU)")
+    ap.add_argument("--durable", action="store_true",
+                    help="run the ring loop through run_durable "
+                    "(checkpoint/resume, watchdog, retry+degradation)")
+    ap.add_argument("--resume", action="store_true",
+                    help="resume an interrupted --durable run from "
+                    "--run-dir instead of starting fresh")
+    ap.add_argument("--run-dir", default=None,
+                    help="snapshot directory for --durable/--resume "
+                    "(default: ./stream_run)")
+    ap.add_argument("--snapshot-every", type=int, default=16,
+                    help="ring cycles between durable snapshots")
+    ap.add_argument("--poison", type=int, default=0,
+                    help="inject N NaN rows into the staged batches "
+                    "before admission (quarantine demo lane)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -197,6 +211,35 @@ def main() -> None:
             detail["ring_k"] = k
             detail["ring_bytes"] = int(ring.nbytes)
 
+            # (2b) durable lane: quarantine admission (+ optional poison
+            # demo) and the checkpointed segment loop — slower than the
+            # one-dispatch loop (one snapshot D2H per segment), priced
+            # separately in detail.durable, never the headline
+            if args.poison or args.durable or args.resume:
+                host_batches = [np.array(b) for b in np.asarray(ring)]
+                if args.poison:
+                    host_batches[0][: args.poison] = np.nan
+                ring, q_report = sj.admit(host_batches, bounds=bbox)
+                detail["quarantine"] = q_report.metrics()
+            if args.durable or args.resume:
+                run_dir = args.run_dir or "stream_run"
+                if args.resume:
+                    res_d = sj.resume(run_dir, ring)
+                else:
+                    res_d = sj.run_durable(
+                        ring, n_batches, run_dir=run_dir,
+                        snapshot_every=args.snapshot_every,
+                        extra_arrays={"gen_key": np.asarray(key)},
+                    )
+                detail["durable"] = dict(
+                    res_d.metrics,
+                    wall_s=round(res_d.wall_s, 3),
+                    points_per_sec=round(res_d.points_per_sec, 1),
+                    checksum=res_d.checksum,
+                    matches=res_d.matches,
+                    overflow=res_d.overflow,
+                )
+
             # (3) the join loop over the ring, prefetch on — ONE
             # dispatch, one (3,) result pull (per-batch python dispatch
             # over the tunnel measured 146 ms/batch for a ~63 ms device
@@ -215,6 +258,14 @@ def main() -> None:
                 overflow=res.overflow,
                 checksum=res.checksum,
             )
+            if "durable" in detail:
+                # the checkpointed segment loop must fold to the same
+                # stats as the one-dispatch loop (free cross-check)
+                detail["durable"]["consistent_with_loop"] = bool(
+                    detail["durable"]["checksum"] == res.checksum
+                    and detail["durable"]["matches"] == res.matches
+                    and detail["durable"]["overflow"] == res.overflow
+                )
 
             # (4) prefetch A/B: same ring without the double buffer
             # (costs one extra loop compile — --no-ab on flaky tunnels)
