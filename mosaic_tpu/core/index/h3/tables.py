@@ -164,30 +164,17 @@ def derive() -> BaseCellTables:
             fijk_bc[f, i, j, k] = b
             fijk_rot[f, i, j, k] = (6 - rot) % 6
 
-    # pentagon cw-offset faces: the two appearance faces whose calibrated
-    # rotation is "odd" relative to the pentagon's 5-sector symmetry. A
-    # pentagon has 5 appearances with rotations {r0..r4}; on the icosahedron
-    # exactly two of the five faces meet the vertex such that the projected
-    # i-axis winds clockwise. We detect them via the rotation parity of the
-    # face ring around the vertex.
-    pent_cw = np.full((C.NUM_BASE_CELLS, 2), -1, dtype=np.int64)
-    for u, members in enumerate(uniq_members):
-        b = int(renum[u])
-        if not is_pent[b]:
-            continue
-        rots = {}
-        for a in members:
-            f = int(faces[a])
-            i, j, k = (int(v) for v in ijk[a])
-            rots[f] = int(fijk_rot[f, i, j, k])
-        # faces with rotation that is NOT expressible as a pentagon rotation
-        # (multiples of 72deg quantized on the 60deg lattice cover rotations
-        # {0,1,2,4,5} differently); empirically the cw-offset faces are the
-        # ones whose observed rotation relative to home is 'behind' the ring.
-        # Round-1 heuristic: mark the two faces with the largest rotation.
-        order_f = sorted(rots.items(), key=lambda kv: kv[1], reverse=True)
-        pent_cw[b, 0] = order_f[0][0]
-        pent_cw[b, 1] = order_f[1][0]
+    # pentagon corner entries: the angle calibration above is exact for
+    # hexagon appearances but NOT around icosahedron vertices — five faces
+    # meet there, one combinatorial ring step is 72 deg physically yet
+    # exactly ONE digit-space rotation unit, so quantizing cumulative
+    # gnomonic angles to 60 deg multiples misassigns some rotations (the
+    # PR-3 triage bug: ~0.9% of uniform sphere points near vertices were
+    # sent to a cell ~11 deg away). Recalibrate every pentagon corner
+    # entry by cross-frame label agreement, and derive the cw-offset
+    # faces from the same probes (replacing the round-1 "two largest
+    # rotations" heuristic, which picked the wrong pair).
+    pent_cw = _calibrate_pentagon_corners(is_pent, home_face, fijk_bc, fijk_rot)
 
     edge_nf, edge_rot, edge_t, edge_cidx = _add_overage_entries(
         faces, ijk, cell_of, renum, uniq_members, fijk_bc, fijk_rot
@@ -206,6 +193,224 @@ def derive() -> BaseCellTables:
         edge_translate=edge_t,
         edge_corner_idx=edge_cidx,
     )
+
+
+#: pentagon-calibration resolution: fine enough that the narrow edge band
+#: holds thousands of distinct cells, coarse enough to stay fast
+_CAL_RES = 6
+
+
+def _forced_face_digits(la, lng, res, f):
+    """(digits, base i, j, k) of probe points evaluated in face ``f``'s
+    frame (the geo_to_cell up-aggregation with the face forced — the
+    calibration needs the SAME physical points described in two frames)."""
+    face = np.full(la.shape, f, dtype=np.int64)
+    _, x, y = hm.geo_to_hex2d(la, lng, res, face=face)
+    i, j, k = hm.hex2d_to_ijk(x, y, np)
+    digits = np.full(la.shape + (C.MAX_RES,), C.INVALID_DIGIT, dtype=np.int64)
+    for r in range(res, 0, -1):
+        li, lj, lk = i, j, k
+        if hm.is_class_iii(r):
+            i, j, k = hm.up_ap7(i, j, k, np)
+            ci, cj, ck = hm.down_ap7(i, j, k, np)
+        else:
+            i, j, k = hm.up_ap7r(i, j, k, np)
+            ci, cj, ck = hm.down_ap7r(i, j, k, np)
+        di, dj, dk = hm.ijk_normalize(li - ci, lj - cj, lk - ck, np)
+        digits[..., r - 1] = hm.unit_ijk_to_digit(di, dj, dk, np)
+    return digits, i, j, k
+
+
+def _pent_relabel(digits, res, rot, cw):
+    """Digits -> canonical pentagon digits for a trial ``(rot, cw)``: the
+    deleted-K-sector adjustment (cw/ccw 60 deg) where the leading digit is
+    K, then ``rot`` pentagon rotations — exactly the geo_to_cell path."""
+    lead = hm.leading_nonzero_digit(digits, res, np)
+    need = lead == C.K_AXES_DIGIT
+    adj = (
+        hm.rotate60_cw(digits, res, np)
+        if cw
+        else hm.rotate60_ccw(digits, res, np)
+    )
+    d = np.where(need[:, None], adj, digits)
+    for n in range(1, 6):
+        if rot >= n:
+            d = hm.rotate_pent60_ccw(d, res, np)
+    return d
+
+
+def _calibrate_pentagon_corners(is_pent, home_face, fijk_bc, fijk_rot):
+    """Fix the pentagon corner-entry rotations in ``fijk_rot`` (in place)
+    and return the derived ``pent_cw`` table.
+
+    Method: adjacent appearance faces share a triangle edge whose
+    gnomonic parametrization is IDENTICAL in both frames (the mirror
+    isometry through the edge's great circle swaps the faces and fixes
+    the edge), so in a narrow band (±5e-4 rad) along it the two frames'
+    res-6 lattices coincide and the same physical point must get the
+    same digit string after relabeling. Pass 1 pins each face's rotation
+    against an already-calibrated neighbor (BFS from the home face,
+    rot=0 by definition) using probes whose leading digit is not K in
+    either frame (cw-independent). Pass 2 pins the cw-offset faces:
+    probes K-leading in one frame only vote on that face's fold
+    direction; a face is cw-offset only on strong evidence (its deleted
+    sector hugs the shared edge, thousands of probes). Deterministic
+    (fixed seed); raises if any pair calibrates below 60% agreement —
+    the correct relabeling scores ~0.85+ (residual = cells straddling
+    the band), wrong ones ~0.
+    """
+    rng = np.random.default_rng(20260804)
+    pent_cw = np.full((C.NUM_BASE_CELLS, 2), -1, dtype=np.int64)
+
+    def corner_cells(f):
+        return {
+            int(fijk_bc[f, c[0], c[1], c[2]]): tuple(int(v) for v in c)
+            for c in _CORNER_IJK
+        }
+
+    def corner_geo(f, ijk):
+        cx, cy = hm.ijk_to_hex2d(float(ijk[0]), float(ijk[1]), float(ijk[2]))
+        la, lng = hm.hex2d_to_geo(
+            np.int64(f), np.asarray(cx), np.asarray(cy), 0
+        )
+        return np.array([
+            np.cos(la) * np.cos(lng), np.cos(la) * np.sin(lng), np.sin(la),
+        ]).reshape(3)
+
+    for b in np.nonzero(is_pent)[0]:
+        b = int(b)
+        hf = int(home_face[b])
+        apps = {}
+        for f in range(C.NUM_FACES):
+            cc = corner_cells(f)
+            if b in cc:
+                apps[f] = cc[b]
+        v = corner_geo(hf, apps[hf])
+        edge2 = {}
+        for f in apps:
+            cf = corner_cells(f)
+            for g in apps:
+                if g == f:
+                    continue
+                shared = set(cf) & set(corner_cells(g))
+                if b in shared and len(shared) == 2:
+                    edge2[(f, g)] = (shared - {b}).pop()
+
+        bands: dict = {}
+
+        def band(f, g):
+            """Masked digit strings of shared-edge-band probes in both
+            frames (cached per unordered pair)."""
+            if (f, g) in bands:
+                return bands[(f, g)]
+            if (g, f) in bands:
+                dg, df = bands[(g, f)]
+                return df, dg
+            v2 = corner_geo(f, corner_cells(f)[edge2[(f, g)]])
+            d = v2 - (v2 @ v) * v
+            d /= np.linalg.norm(d)
+            nrm = np.cross(v, d)
+            n = 5000
+            ts = rng.uniform(0.04, 0.30, n)
+            hs = rng.uniform(-5e-4, 5e-4, n)
+            p = (
+                np.cos(ts)[:, None] * v
+                + np.sin(ts)[:, None] * d
+                + hs[:, None] * nrm
+            )
+            p /= np.linalg.norm(p, axis=1, keepdims=True)
+            la = np.arcsin(p[:, 2])
+            lng = np.arctan2(p[:, 1], p[:, 0])
+            df, fi, fj, fk = _forced_face_digits(la, lng, _CAL_RES, f)
+            dg, gi, gj, gk = _forced_face_digits(la, lng, _CAL_RES, g)
+            cf, cg = apps[f], apps[g]
+            m = (
+                (fi == cf[0]) & (fj == cf[1]) & (fk == cf[2])
+                & (gi == cg[0]) & (gj == cg[1]) & (gk == cg[2])
+            )
+            bands[(f, g)] = (df[m], dg[m])
+            return bands[(f, g)]
+
+        def neighbors(f):
+            for (a, b2) in edge2:
+                if a == f:
+                    yield b2
+
+        # pass 1: rotations, BFS out from home (rot 0 by definition)
+        rots = {hf: 0}
+        frontier = [hf]
+        while frontier:
+            nxt = []
+            for g in frontier:
+                for f in neighbors(g):
+                    if f in rots:
+                        continue
+                    df, dg = band(f, g)
+                    no_k = (
+                        hm.leading_nonzero_digit(df, _CAL_RES, np)
+                        != C.K_AXES_DIGIT
+                    ) & (
+                        hm.leading_nonzero_digit(dg, _CAL_RES, np)
+                        != C.K_AXES_DIGIT
+                    )
+                    ref = _pent_relabel(dg[no_k], _CAL_RES, rots[g], False)
+                    score, rot = max(
+                        (
+                            float(
+                                (_pent_relabel(df[no_k], _CAL_RES, r, False)
+                                 == ref).all(axis=1).mean()
+                            ),
+                            r,
+                        )
+                        for r in range(5)
+                    )
+                    if score < 0.6:
+                        raise AssertionError(
+                            f"pentagon {b}: face {f} vs {g} calibrated at "
+                            f"{score:.2f} agreement — probe band too noisy"
+                        )
+                    rots[f] = rot
+                    nxt.append(f)
+            frontier = nxt
+        for f, rot in rots.items():
+            c = apps[f]
+            fijk_rot[f, c[0], c[1], c[2]] = rot
+
+        # pass 2: cw-offset faces from K-leading probes (one frame only)
+        cw_faces = []
+        for f in apps:
+            for g in neighbors(f):
+                df, dg = band(f, g)
+                m = (
+                    hm.leading_nonzero_digit(df, _CAL_RES, np)
+                    == C.K_AXES_DIGIT
+                ) & (
+                    hm.leading_nonzero_digit(dg, _CAL_RES, np)
+                    != C.K_AXES_DIGIT
+                )
+                # only a deleted sector hugging this edge yields a strong
+                # probe population; scattered boundary rounding does not
+                if int(m.sum()) < 500:
+                    continue
+                ref = _pent_relabel(dg[m], _CAL_RES, rots[g], False)
+                cw_score = float(
+                    (_pent_relabel(df[m], _CAL_RES, rots[f], True) == ref)
+                    .all(axis=1).mean()
+                )
+                ccw_score = float(
+                    (_pent_relabel(df[m], _CAL_RES, rots[f], False) == ref)
+                    .all(axis=1).mean()
+                )
+                if cw_score > max(ccw_score, 0.6):
+                    cw_faces.append(f)
+                break
+        if len(cw_faces) > 2:
+            raise AssertionError(
+                f"pentagon {b}: {len(cw_faces)} cw-offset faces {cw_faces}"
+            )
+        for slot, f in enumerate(sorted(cw_faces)):
+            pent_cw[b, slot] = f
+    return pent_cw
 
 
 # overage res-0 positions: normalized ijk with min==0 and 2 < i+j+k <= 4 —

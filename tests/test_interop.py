@@ -50,14 +50,6 @@ def test_arrow_roundtrip(zones, fmt):
         assert back.columns[k].tolist() == v.tolist()
 
 
-@pytest.mark.xfail(
-    condition=not os.path.exists(NYC),
-    reason="without the reference NYC fixture the fallback zones sit at "
-    "(0..2, 0..2) — inside H3 pentagon base cell 58's icosahedron-vertex "
-    "region, where point_to_cell/cell_center disagree (pre-existing "
-    "projection bug, ROADMAP open item; polyfill there returns 0 cells)",
-    strict=False,
-)
 def test_map_in_arrow_batch_pipeline(zones):
     """The exact mapInArrow contract: iterator of RecordBatches in,
     iterator of RecordBatches out — here computing per-zone H3 cover
