@@ -71,6 +71,36 @@ class StalledDeviceError(TransientDeviceError):
         self.elapsed_s = elapsed_s
 
 
+class Overloaded(MosaicRuntimeError):
+    """The serving engine refused (or abandoned) a request under load.
+
+    Raised by `mosaic_tpu/serve/admission.py` instead of queueing without
+    bound: either the bounded request queue is full at admission
+    (``reason="queue_full"``), the request's deadline expired before its
+    results could be delivered (``reason="deadline"``), or the engine
+    shut down with the request still queued (``reason="shutdown"``).
+    Typed so callers can distinguish load shedding — retry later,
+    against another replica — from a wrong answer, which this never is.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        reason: str = "",
+        queue_depth: int = 0,
+        capacity: int = 0,
+        deadline_s: float = 0.0,
+        elapsed_s: float = 0.0,
+    ):
+        super().__init__(message)
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.capacity = capacity
+        self.deadline_s = deadline_s
+        self.elapsed_s = elapsed_s
+
+
 class RetryExhausted(MosaicRuntimeError):
     """The bounded transient-retry budget ran out without a success.
 

@@ -13,7 +13,8 @@ device entry points consult (`pip_join`, `dist_pip_join`,
 - :func:`stalls` plans a simulated hang (seconds of dead time) inside
   the next N watchdog-guarded calls, so `runtime/watchdog.py` deadlines
   are exercised for real (the mid-stream sites: ``stream.scan_step``,
-  ``stream.snapshot``, ``stream.prefetch``);
+  ``stream.snapshot``, ``stream.prefetch``; the serving sites:
+  ``serve.admit``, ``serve.batch``, ``serve.dispatch``);
 - :func:`corrupt_batches` poisons the first rows of batches passing
   through :func:`maybe_corrupt` (NaN coordinates by default) — the
   quarantine layer's adversarial-input model;
@@ -79,6 +80,23 @@ def _plans() -> list[FaultPlan]:
 def active() -> bool:
     """Is any fault plan installed on this thread?"""
     return bool(getattr(_LOCAL, "plans", None))
+
+
+def current_plans() -> list:
+    """This thread's live fault-plan list — hand it to
+    :func:`adopt_plans` on a worker thread so plans installed by the
+    caller (plans are thread-local) still trip hooks evaluated there.
+    The serving engine's micro-batcher does this: a test installs a
+    ``serve.dispatch`` stall on the test thread, and the dispatch worker
+    must see it (mirrors ``telemetry.current_sinks``/``adopt_sinks``;
+    list mutation is GIL-atomic, so sharing is safe)."""
+    return _plans()
+
+
+def adopt_plans(plans: list) -> None:
+    """Make ``plans`` (a :func:`current_plans` result from another
+    thread) this thread's fault-plan list."""
+    _LOCAL.plans = plans
 
 
 @contextlib.contextmanager

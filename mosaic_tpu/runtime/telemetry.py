@@ -89,6 +89,47 @@ def timed(event: str, **fields):
         )
 
 
+def summarize(
+    events, event: str | None = None, key: str = "seconds"
+) -> dict:
+    """Percentile summary of one numeric field over recorded events.
+
+    ``{"count", "p50", "p90", "p99", "mean", "max", "sum"}`` over
+    ``e[key]`` for every event dict in ``events`` carrying the field
+    (restricted to ``e["event"] == event`` when given); all values 0.0
+    when nothing matches. The ONE percentile implementation the benches
+    share (`tools/serve_bench.py` latencies, `tools/stream_bench.py`
+    stage timings) — a p99 computed two different ad-hoc ways is two
+    different metrics.
+    """
+    vals = [
+        float(e[key])
+        for e in events
+        if key in e and (event is None or e.get("event") == event)
+    ]
+    if not vals:
+        return {
+            "count": 0, "p50": 0.0, "p90": 0.0, "p99": 0.0,
+            "mean": 0.0, "max": 0.0, "sum": 0.0,
+        }
+    vals.sort()
+    n = len(vals)
+
+    def pct(q: float) -> float:
+        # nearest-rank on the sorted sample: stable for tiny n
+        return vals[min(n - 1, max(0, int(round(q * (n - 1)))))]
+
+    return {
+        "count": n,
+        "p50": round(pct(0.50), 6),
+        "p90": round(pct(0.90), 6),
+        "p99": round(pct(0.99), 6),
+        "mean": round(sum(vals) / n, 6),
+        "max": round(vals[-1], 6),
+        "sum": round(sum(vals), 6),
+    }
+
+
 @contextlib.contextmanager
 def capture():
     """Collect every resilience event emitted in the block (thread-local).

@@ -1,0 +1,214 @@
+"""Admission control: bounded queue, per-request deadlines, poison
+diversion — the engine's contract that load NEVER turns into unbounded
+memory, unbounded latency, or a corrupted shared batch.
+
+Three decisions happen at the submit boundary, in order:
+
+1. **Fault hooks** — ``serve.admit`` is a fault-injection site:
+   `runtime/faults.maybe_fail` can raise a synthetic transient here and
+   `maybe_corrupt` can poison the request's rows (the adversarial-input
+   model the quarantine tests drive).
+2. **Quarantine** — rows that are non-finite or outside the declared
+   domain bounds are *parked* (`runtime/quarantine.py`): replaced by a
+   coordinate proven to hit no indexed cell, so they answer -1 without
+   special-casing the kernel and cannot perturb batchmates. The request
+   still gets a result; the report rides on it.
+3. **Backpressure** — the queue is a bounded deque. At capacity the
+   request is REFUSED with a typed
+   :class:`~mosaic_tpu.runtime.errors.Overloaded` (``reason=
+   "queue_full"``) instead of queueing: an overloaded engine must shed
+   at the door, where the caller can retry elsewhere, not time out
+   silently after occupying memory for seconds.
+
+Deadlines are stamped here (monotonic clock) and enforced by the
+batcher at both batch formation and scatter-back — a request that
+cannot make its deadline is shed with ``reason="deadline"``, and ONLY
+that request: batchmates keep their results.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..runtime import (
+    faults as _faults,
+    quarantine as _quarantine,
+    telemetry as _telemetry,
+)
+from ..runtime.errors import Overloaded
+
+
+@dataclasses.dataclass
+class Request:
+    """One admitted request queued for dispatch."""
+
+    points: np.ndarray  # (n, 2) f64, poison rows already parked
+    future: Future
+    n: int
+    t_submit: float  # monotonic
+    deadline: float | None  # monotonic instant, None = no deadline
+    parked: int = 0  # rows diverted to quarantine
+    quarantine: "_quarantine.QuarantineReport | None" = None
+    #: caller-thread context the dispatch worker adopts (both are
+    #: thread-local in their modules)
+    sinks: list = dataclasses.field(default_factory=list)
+    plans: list = dataclasses.field(default_factory=list)
+
+    def remaining(self, now: float | None = None) -> float:
+        if self.deadline is None:
+            return float("inf")
+        return self.deadline - (time.monotonic() if now is None else now)
+
+
+class AdmissionController:
+    """Bounded request queue with deadline stamping and poison parking.
+
+    ``capacity`` bounds QUEUED requests (in-flight batches are bounded
+    separately by the batcher's window); ``default_deadline_s`` applies
+    when a submit passes none; ``bounds`` is the (xmin, ymin, xmax,
+    ymax) valid domain for quarantine scrubbing (None: non-finite rows
+    only). ``park_point`` short-circuits the park search; otherwise the
+    first poisoned admit derives one from ``find_park`` (the engine
+    wires the index-aware search in).
+    """
+
+    def __init__(
+        self,
+        *,
+        capacity: int = 256,
+        default_deadline_s: float | None = None,
+        bounds: tuple | None = None,
+        park_point: np.ndarray | None = None,
+        find_park=None,
+    ):
+        self.capacity = int(capacity)
+        self.default_deadline_s = default_deadline_s
+        self.bounds = bounds
+        self._park = (
+            None
+            if park_point is None
+            else np.asarray(park_point, dtype=np.float64)
+        )
+        self._find_park = find_park
+        self._queue: list[Request] = []
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self.metrics = {
+            "submitted": 0,
+            "admitted": 0,
+            "shed_queue_full": 0,
+            "quarantined_rows": 0,
+            "poisoned_requests": 0,
+        }
+
+    # ------------------------------------------------------ submit side
+
+    def admit(
+        self, points: np.ndarray, *, deadline_s: float | None = None
+    ) -> Request:
+        """Scrub, stamp, and enqueue one request; returns it with its
+        future. Raises :class:`Overloaded` when the queue is full."""
+        _faults.maybe_fail("serve.admit")
+        raw = np.asarray(
+            _faults.maybe_corrupt("serve.admit", points), dtype=np.float64
+        )
+        if raw.ndim != 2 or raw.shape[1] != 2:
+            raise ValueError(f"expected (n, 2) points, got {raw.shape}")
+        self.metrics["submitted"] += 1
+
+        report = None
+        parked = 0
+        bad, reasons = _quarantine.scrub_points(raw, bounds=self.bounds)
+        if bad.any():
+            report = _quarantine.QuarantineReport()
+            report.merge_batch(0, raw, bad, reasons)
+            clean = raw.copy()
+            clean[bad] = self._park_point(raw)
+            parked = int(bad.sum())
+            self.metrics["quarantined_rows"] += parked
+            self.metrics["poisoned_requests"] += 1
+            _telemetry.record(
+                "serve_quarantine", rows=parked, of=int(raw.shape[0]),
+                reasons={k: v for k, v in reasons.items() if v},
+            )
+            raw = clean
+
+        now = time.monotonic()
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = Request(
+            points=raw,
+            future=Future(),
+            n=int(raw.shape[0]),
+            t_submit=now,
+            deadline=None if deadline_s is None else now + float(deadline_s),
+            parked=parked,
+            quarantine=report,
+            sinks=_telemetry.current_sinks(),
+            plans=_faults.current_plans(),
+        )
+        with self._not_empty:
+            depth = len(self._queue)
+            if depth >= self.capacity:
+                self.metrics["shed_queue_full"] += 1
+                _telemetry.record(
+                    "serve_shed", reason="queue_full", queue_depth=depth,
+                    capacity=self.capacity,
+                )
+                raise Overloaded(
+                    f"serve queue full ({depth}/{self.capacity} requests) "
+                    f"— shedding at admission",
+                    reason="queue_full",
+                    queue_depth=depth,
+                    capacity=self.capacity,
+                )
+            self._queue.append(req)
+            self.metrics["admitted"] += 1
+            self._not_empty.notify()
+        return req
+
+    def _park_point(self, raw: np.ndarray) -> np.ndarray:
+        if self._park is None:
+            if self._find_park is None:
+                raise ValueError(
+                    "admission needs a park_point or find_park to divert "
+                    "poisoned rows"
+                )
+            self._park = np.asarray(
+                self._find_park(raw), dtype=np.float64
+            )
+        return self._park
+
+    # ---------------------------------------------------- consumer side
+
+    def take(self, timeout: float | None) -> Request | None:
+        """Pop the oldest request, waiting up to ``timeout``; None on
+        timeout (the batcher's idle tick)."""
+        with self._not_empty:
+            if not self._queue:
+                self._not_empty.wait(timeout)
+            if not self._queue:
+                return None
+            return self._queue.pop(0)
+
+    def put_back(self, req: Request) -> None:
+        """Return a request to the queue HEAD (the batcher overshot its
+        row budget — this request leads the next batch)."""
+        with self._not_empty:
+            self._queue.insert(0, req)
+            self._not_empty.notify()
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def drain(self) -> list[Request]:
+        """Remove and return every queued request (shutdown path)."""
+        with self._lock:
+            out, self._queue = self._queue, []
+            return out

@@ -1,0 +1,333 @@
+"""The request lifecycle: submit -> future -> padded, shape-bucketed
+device dispatch with the full runtime resilience stack wired in.
+
+One :class:`ServeEngine` owns a resident
+:class:`~mosaic_tpu.sql.join.ChipIndex` and turns concurrent
+point-in-polygon requests into micro-batched dispatches on the
+module-level jitted join (`sql/join._JIT_JOIN` — the same executable
+cache `pip_join` uses, so a server and a batch job in one process share
+compiles). The pipeline per batch:
+
+    admit (quarantine + backpressure, `serve/admission.py`)
+      -> coalesce (max-batch / max-wait window, `serve/batcher.py`)
+      -> pad to bucket (`serve/bucket.py`)
+      -> assign cells + probe under the ``serve.dispatch``
+         watchdog/fault site, transient retry, host-oracle degradation
+      -> scatter back per request, shedding only deadline-expired ones
+
+Resilience wiring (all reused, none reimplemented):
+
+- `runtime/watchdog.py` guards the blocking dispatch; its default
+  deadline is the batch's largest remaining request deadline (plus
+  grace), so a hung device surfaces as a typed ``StalledDeviceError``
+  while the requests still have budget to retry or degrade.
+- `runtime/retry.py` retries transient failures with backoff; past the
+  budget the batch degrades to the exact f64 host oracle and every
+  result is flagged :class:`DegradedResult` — callers get exact values
+  and the truth about how they were computed.
+- `runtime/faults.py` sites ``serve.admit`` / ``serve.batch`` /
+  ``serve.dispatch`` make every failure mode injectable from tests.
+- every stage emits ``serve_stage`` `telemetry.timed` events; per-request
+  latency lands in ``serve_request`` events (`telemetry.summarize` turns
+  them into the bench's p50/p99).
+
+Compile discipline: caps are fixed at the full bucket (overflow is
+structurally impossible, so no escalation can change a static argument
+at runtime), and :meth:`warmup` precompiles every bucket against the
+resident index. After warmup the signature set is frozen — a dispatch
+introducing a new signature emits a ``serve_compile`` event and counts
+in ``metrics()["cold_compiles"]`` (the serve tests pin this at zero).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..runtime import telemetry as _telemetry, watchdog as _watchdog
+from ..runtime.retry import call_with_retry
+from ..sql import join as _join
+from .admission import AdmissionController
+from .batcher import MicroBatcher
+from .bucket import BucketLadder, backend_compiles, dispatch_signature
+
+import jax
+import jax.numpy as jnp
+
+
+class ServeEngine:
+    """Online serving engine over a resident chip index.
+
+    >>> engine = ServeEngine(index, h3, 9, bounds=bbox)
+    >>> engine.warmup()
+    >>> fut = engine.submit(points)          # (n, 2) -> Future
+    >>> rows = fut.result(timeout=1.0)       # (n,) int32, -1 = no match
+    """
+
+    def __init__(
+        self,
+        index,
+        index_system,
+        resolution: int,
+        *,
+        ladder: BucketLadder | None = None,
+        max_batch_rows: int | None = None,
+        max_wait_s: float = 0.002,
+        queue_capacity: int = 256,
+        default_deadline_s: float | None = 1.0,
+        bounds: tuple | None = None,
+        park_point: np.ndarray | None = None,
+        writeback: str = "scatter",
+        lookup: str | None = None,
+        cell_dtype=None,
+        watchdog_grace_s: float = 0.5,
+    ):
+        self.index = index
+        self.index_system = index_system
+        self.resolution = index_system.resolution_arg(resolution)
+        self.ladder = ladder or BucketLadder()
+        self.writeback = writeback
+        self.cell_dtype = cell_dtype
+        self.watchdog_grace_s = float(watchdog_grace_s)
+        dtype = index.border.verts.dtype
+        if lookup is None:
+            lookup = (
+                "mxu"
+                if jax.devices()[0].platform != "cpu"
+                and dtype == jnp.float32
+                else "gather"
+            )
+        self.lookup = lookup
+        self._dtype = dtype
+        host = getattr(index, "host", None)
+        self._host = host
+        self._shift = (
+            host.shift
+            if host is not None
+            else np.asarray(index.border.shift, dtype=np.float64)
+        )
+        self._signatures: set = set()
+        self._warmed: frozenset | None = None
+        self._cold_compiles = 0
+
+        self.admission = AdmissionController(
+            capacity=queue_capacity,
+            default_deadline_s=default_deadline_s,
+            bounds=bounds,
+            park_point=park_point,
+            find_park=self._derive_park,
+        )
+        self.batcher = MicroBatcher(
+            self.admission,
+            self._dispatch,
+            max_batch_rows=(
+                min(self.ladder.max_bucket, 16384)
+                if max_batch_rows is None
+                else int(max_batch_rows)
+            ),
+            max_wait_s=max_wait_s,
+        )
+        if self.batcher.max_batch_rows > self.ladder.max_bucket:
+            raise ValueError(
+                f"max_batch_rows {self.batcher.max_batch_rows} exceeds the "
+                f"top bucket {self.ladder.max_bucket}"
+            )
+        self._closed = False
+        self.batcher.start()
+
+    # ----------------------------------------------------------- public
+
+    def submit(self, points, *, deadline_s: float | None = None):
+        """Enqueue one request; returns its ``concurrent.futures.Future``
+        resolving to the (n,) int32 matches (:class:`Overloaded` when
+        shed). Raises :class:`Overloaded` at admission when the queue is
+        full."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        pts = np.asarray(points, dtype=np.float64)
+        if pts.ndim != 2 or pts.shape[1] != 2:
+            raise ValueError(f"expected (n, 2) points, got {pts.shape}")
+        if pts.shape[0] > self.ladder.max_bucket:
+            raise ValueError(
+                f"request of {pts.shape[0]} rows exceeds the top bucket "
+                f"{self.ladder.max_bucket} — split it upstream"
+            )
+        return self.admission.admit(pts, deadline_s=deadline_s).future
+
+    def join(self, points, *, deadline_s: float | None = None, timeout=None):
+        """Synchronous convenience wrapper: submit and wait."""
+        return self.submit(points, deadline_s=deadline_s).result(timeout)
+
+    def warmup(self) -> dict:
+        """Precompile every ladder bucket against the resident index.
+
+        Runs the exact dispatch path (cell assignment + jitted probe) on
+        an inert full-bucket batch per rung, so the first real request
+        at any admitted shape replays a cached executable. Returns
+        ``{"buckets": ..., "seconds": ..., "signatures": ...}``; after
+        this, any dispatch that still introduces a new compile signature
+        is counted in ``metrics()["cold_compiles"]`` (and emits a
+        ``serve_compile`` event) — the bounded-compile contract's
+        tripwire."""
+        t0 = backend_compiles()
+        total = 0.0
+        with _telemetry.capture() as events:
+            for b in self.ladder.buckets:
+                pts = np.zeros((b, 2), dtype=np.float64)
+                with _telemetry.timed(
+                    "serve_stage", stage="warmup", bucket=b
+                ):
+                    self._dispatch_device(pts)
+        total = sum(
+            e["seconds"]
+            for e in events
+            if e.get("stage") == "warmup" and "seconds" in e
+        )
+        self._warmed = frozenset(self._signatures)
+        t1 = backend_compiles()
+        out = {
+            "buckets": len(self.ladder.buckets),
+            "seconds": round(total, 4),
+            "signatures": len(self._signatures),
+        }
+        if t0 is not None and t1 is not None:
+            out["backend_compiles"] = t1 - t0
+        _telemetry.record("serve_warmup", **out)
+        return out
+
+    def metrics(self) -> dict:
+        a, b = self.admission.metrics, self.batcher.metrics
+        out = dict(a)
+        out.update(b)
+        out["shed"] = a["shed_queue_full"] + b["shed_deadline"]
+        out["quarantined"] = a["quarantined_rows"]
+        out["queue_depth"] = self.admission.depth()
+        out["compile_signatures"] = len(self._signatures)
+        out["cold_compiles"] = self._cold_compiles
+        out["occupancy_mean"] = round(
+            b["occupancy_sum"] / b["batches"], 4
+        ) if b["batches"] else 0.0
+        return out
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the batcher; queued requests are shed
+        (``reason="shutdown"``)."""
+        if not self._closed:
+            self._closed = True
+            self.batcher.stop(timeout)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # --------------------------------------------------------- dispatch
+
+    def _dispatch(self, points: np.ndarray, deadline_hint=None):
+        """Batcher callback: pad, dispatch with resilience, unpad.
+        Returns ``(results (n,), occupancy)``."""
+        padded, n = self.ladder.pad(points)
+        bucket = padded.shape[0]
+        with _telemetry.timed(
+            "serve_stage", stage="dispatch", bucket=bucket, rows=n,
+        ):
+            out = self._dispatch_resilient(padded, deadline_hint)
+        occupancy = n / bucket
+        return out[:n], occupancy
+
+    def _caps(self, bucket: int):
+        """Full-bucket caps: overflow structurally impossible, so the
+        static-arg set per bucket never changes at runtime."""
+        fcap = None if self.writeback == "direct" else bucket
+        hcap = bucket if self.index.num_heavy_cells else None
+        return fcap, hcap
+
+    def _dispatch_device(self, padded: np.ndarray) -> np.ndarray:
+        """One exact device join of a full-bucket batch (the compile
+        unit warmup precompiles and dispatch replays)."""
+        bucket = padded.shape[0]
+        fcap, hcap = self._caps(bucket)
+        sig = dispatch_signature(
+            bucket, self.index, writeback=self.writeback,
+            lookup=self.lookup, found_cap=fcap, heavy_cap=hcap,
+        )
+        if sig not in self._signatures:
+            self._signatures.add(sig)
+            if self._warmed is not None:
+                self._cold_compiles += 1
+                _telemetry.record(
+                    "serve_compile", bucket=bucket,
+                    signatures=len(self._signatures),
+                )
+        dev = jnp.asarray(padded)
+        if self.cell_dtype is not None:
+            dev = dev.astype(self.cell_dtype)
+        # always the JITTED cell program (shared `_cells_prog` lru, one
+        # compile per bucket, precompiled by warmup): the batch-path
+        # heuristic of going eager below 64k rows on CPU trades a
+        # one-off compile for a ~1000x slower dispatch — the right trade
+        # for a single cold batch, the wrong one on a serving hot path
+        cells = _join._cells_prog(
+            self.index_system, self.resolution, "cells"
+        )(dev)
+        shifted = jnp.asarray(padded - self._shift, dtype=self._dtype)
+        return np.asarray(
+            _join._JIT_JOIN(
+                shifted, cells, self.index,
+                heavy_cap=hcap, found_cap=fcap,
+                writeback=self.writeback, lookup=self.lookup,
+            )
+        )
+
+    def _dispatch_resilient(self, padded, deadline_hint) -> np.ndarray:
+        """`_dispatch_device` under the watchdog deadline, transient
+        retry, and host-oracle degradation."""
+        default_s = (
+            None
+            if deadline_hint is None
+            else max(float(deadline_hint), 0.05) + self.watchdog_grace_s
+        )
+
+        def attempt():
+            return _watchdog.guard(
+                "serve.dispatch", self._dispatch_device, padded,
+                default_s=default_s,
+            )
+
+        fallback = None
+        if self._host is not None:
+            fallback = lambda: _join.host_join(  # noqa: E731
+                padded, self._host, self.index_system, self.resolution
+            )
+        return call_with_retry(
+            attempt, label="serve.dispatch", fallback=fallback
+        )
+
+    # ------------------------------------------------------- quarantine
+
+    def _derive_park(self, raw: np.ndarray) -> np.ndarray:
+        """Index-aware park point for poisoned rows: walk outward from
+        the request's own finite bounding box until a cell NOT in the
+        resident index answers (`runtime/quarantine.find_park_point`)."""
+        from ..runtime import quarantine as _quarantine
+
+        finite = raw[np.isfinite(raw).all(axis=1)]
+        if finite.size:
+            bounds = (
+                float(finite[:, 0].min()), float(finite[:, 1].min()),
+                float(finite[:, 0].max()), float(finite[:, 1].max()),
+            )
+        else:
+            bounds = (0.0, 0.0, 1.0, 1.0)
+        if self.admission.bounds is not None:
+            bounds = self.admission.bounds
+
+        def assign(pts):
+            dev = jnp.asarray(np.asarray(pts, dtype=np.float64))
+            if self.cell_dtype is not None:
+                dev = dev.astype(self.cell_dtype)
+            return self.index_system.point_to_cell(dev, self.resolution)
+
+        return _quarantine.find_park_point(
+            assign, np.asarray(self.index.cells), bounds
+        )

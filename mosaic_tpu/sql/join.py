@@ -1281,6 +1281,68 @@ def _cells_prog(index_system: IndexSystem, resolution: int, variant: str):
     return jax.jit(fn)
 
 
+def join_cache_stats(emit: bool = True) -> dict:
+    """Observability for the module-level join caches.
+
+    ``{"cells_prog": {hits, misses, maxsize, currsize}, "jit_join":
+    n_cached, "jit_compact": n_cached}`` — the `_cells_prog` lru entry
+    count is the number of live (index system, resolution, variant)
+    program keys (each PINS its index-system object for the cache's
+    lifetime), and the jit sizes count compiled (shape, static-args)
+    specializations. Emits one ``join_cache_stats`` telemetry event
+    (``emit=False`` reads silently) so long-running servers can chart
+    growth and decide when to call :func:`clear_join_caches`.
+    """
+    info = _cells_prog.cache_info()
+    stats = {
+        "cells_prog": {
+            "hits": info.hits,
+            "misses": info.misses,
+            "maxsize": info.maxsize,
+            "currsize": info.currsize,
+        },
+        "jit_join": _jit_cache_size(_JIT_JOIN),
+        "jit_compact": _jit_cache_size(_JIT_COMPACT),
+    }
+    if emit:
+        _telemetry.record("join_cache_stats", **stats)
+    return stats
+
+
+def _jit_cache_size(fn) -> int:
+    try:
+        return int(fn._cache_size())
+    except Exception:
+        return -1  # jax version without the introspection hook
+
+
+def clear_join_caches() -> dict:
+    """Release every module-level join cache; returns the pre-clear
+    :func:`join_cache_stats`.
+
+    The `_cells_prog` lru (maxsize 64) holds a strong reference to every
+    index system it ever compiled for — for the built-in singleton
+    systems that retention is harmless, but a long-running server
+    cycling many `CustomIndexSystem` grids (or resolutions) pins each
+    one for process lifetime. This is the escape hatch: drop the cell
+    programs plus the `_JIT_JOIN`/`_JIT_COMPACT` compile caches (they
+    regrow on next use; the next call per shape pays one recompile).
+    Emits ``join_caches_cleared`` telemetry.
+    """
+    stats = join_cache_stats(emit=False)
+    _cells_prog.cache_clear()
+    for fn in (_JIT_JOIN, _JIT_COMPACT):
+        try:
+            fn.clear_cache()
+        except Exception:  # older jax spells it _clear_cache
+            try:
+                fn._clear_cache()
+            except Exception:
+                pass
+    _telemetry.record("join_caches_cleared", **stats)
+    return stats
+
+
 #: below this batch size on CPU, eager per-op dispatch of the cell
 #: pipeline beats its XLA compile (measured ~1 min+ for the unrolled H3
 #: digit pipeline on CPU x64). On accelerators always jit: eager would pay

@@ -1,7 +1,5 @@
 """Arrow / pandas interop boundary (SURVEY §7.6: mapInArrow analog)."""
 
-import os
-
 import numpy as np
 import pytest
 

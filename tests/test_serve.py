@@ -1,0 +1,339 @@
+"""Serving-engine contract (PR 4): shape-bucketed compile discipline,
+co-batched bit-identity, deadline shedding, backpressure, quarantine
+isolation, and degradation — `mosaic_tpu/serve/`."""
+
+import time
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry import wkt
+from mosaic_tpu.core.index import CustomIndexSystem, GridConf
+from mosaic_tpu.core.tessellate import tessellate
+from mosaic_tpu.runtime import faults, telemetry
+from mosaic_tpu.runtime.errors import DegradedResult, Overloaded
+from mosaic_tpu.serve import BucketLadder, ServeEngine
+from mosaic_tpu.sql.join import (
+    build_chip_index,
+    clear_join_caches,
+    join_cache_stats,
+    pip_join,
+)
+
+BBOX = (-25.0, -25.0, 35.0, 20.0)
+RES = 3
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return CustomIndexSystem(GridConf(-180, 180, -90, 90, 2, 10.0, 10.0))
+
+
+@pytest.fixture(scope="module")
+def index(grid):
+    col = wkt.from_wkt(
+        [
+            "POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1))",
+            "POLYGON ((-20 -20, -5 -20, -5 -5, -20 -5, -20 -20))",
+            "POLYGON ((20 -10, 30 -10, 30 5, 20 5, 20 -10))",
+        ]
+    )
+    return build_chip_index(tessellate(col, grid, RES, keep_core_geoms=False))
+
+
+def make_engine(index, grid, **kw):
+    kw.setdefault("ladder", BucketLadder(64, 4096))
+    kw.setdefault("bounds", BBOX)
+    kw.setdefault("max_wait_s", 0.01)
+    return ServeEngine(index, grid, RES, **kw)
+
+
+def rand_points(rng, n):
+    return rng.uniform(BBOX[:2], BBOX[2:], (n, 2))
+
+
+class TestBucketLadder:
+    def test_ladder_rungs(self):
+        lad = BucketLadder(64, 1024)
+        assert lad.buckets == (64, 128, 256, 512, 1024)
+
+    @pytest.mark.parametrize(
+        "n,expect", [(1, 64), (64, 64), (65, 128), (1000, 1024), (1024, 1024)]
+    )
+    def test_bucket_for(self, n, expect):
+        assert BucketLadder(64, 1024).bucket_for(n) == expect
+
+    def test_bucket_for_over_max_raises(self):
+        with pytest.raises(ValueError, match="exceeds the top bucket"):
+            BucketLadder(64, 1024).bucket_for(1025)
+
+    def test_pad_repeats_first_row(self):
+        pts = np.array([[1.0, 2.0], [3.0, 4.0]])
+        padded, n = BucketLadder(4, 16).pad(pts)
+        assert n == 2 and padded.shape == (4, 2)
+        np.testing.assert_array_equal(padded[:2], pts)
+        np.testing.assert_array_equal(padded[2:], [[1.0, 2.0], [1.0, 2.0]])
+
+
+class TestCompileDiscipline:
+    def test_one_compile_per_bucket_after_warmup(self, index, grid):
+        """Over randomized request sizes spanning the ladder, the engine
+        introduces ZERO new compile signatures after warmup()."""
+        with make_engine(index, grid) as eng:
+            info = eng.warmup()
+            assert info["signatures"] == len(eng.ladder.buckets)
+            rng = np.random.default_rng(7)
+            futs = [
+                eng.submit(
+                    rand_points(rng, int(rng.integers(1, 3000))),
+                    deadline_s=30.0,
+                )
+                for _ in range(40)
+            ]
+            for f in futs:
+                f.result(timeout=30)
+            m = eng.metrics()
+            assert m["cold_compiles"] == 0, m
+            assert m["compile_signatures"] == len(eng.ladder.buckets)
+            assert m["completed"] == 40
+
+    def test_cold_dispatch_counts_without_warmup(self, index, grid):
+        with make_engine(index, grid) as eng:
+            eng._warmed = frozenset()  # arm the tripwire, skip warmup
+            eng.join(rand_points(np.random.default_rng(0), 10),
+                     deadline_s=30.0)
+            assert eng.metrics()["cold_compiles"] == 1
+
+
+class TestBitIdentity:
+    def test_cobatched_equals_solo_across_bucket_boundaries(
+        self, index, grid
+    ):
+        """Concurrent requests coalesced into one device batch return
+        EXACTLY the bits of solo execution — including sizes straddling
+        bucket boundaries (63..65, 255..257, ...)."""
+        rng = np.random.default_rng(3)
+        sizes = [63, 64, 65, 1, 255, 256, 257, 100, 1023, 17]
+        reqs = [rand_points(rng, n) for n in sizes]
+        # solo: one engine per request so every dispatch is unbatched
+        solo = []
+        with make_engine(index, grid, max_wait_s=0.0) as eng1:
+            eng1.warmup()
+            for pts in reqs:
+                solo.append(np.asarray(eng1.join(pts, deadline_s=30.0)))
+        # co-batched: submitted together inside one batching window
+        with make_engine(index, grid, max_wait_s=0.05) as eng2:
+            eng2.warmup()
+            futs = [eng2.submit(p, deadline_s=30.0) for p in reqs]
+            outs = [np.asarray(f.result(timeout=30)) for f in futs]
+            assert eng2.metrics()["batches"] < len(reqs)  # really coalesced
+        for pts, a, b in zip(reqs, solo, outs):
+            np.testing.assert_array_equal(a, b)
+            # and both equal the offline batch API
+            ref = np.asarray(
+                pip_join(pts, None, grid, RES, chip_index=index,
+                         recheck=False)
+            )
+            np.testing.assert_array_equal(b, ref)
+
+
+class TestDeadlinesAndShedding:
+    def test_dispatch_stall_sheds_only_the_late_request(self, index, grid):
+        """An injected ``serve.dispatch`` stall delays the shared batch;
+        the request whose deadline expires is shed (typed Overloaded,
+        metrics["shed"]), its batchmate still gets exact results."""
+        with make_engine(index, grid, max_wait_s=0.05) as eng:
+            eng.warmup()
+            rng = np.random.default_rng(11)
+            tight = rand_points(rng, 40)
+            slack = rand_points(rng, 50)
+            with faults.stalls(0.8, n=1, sites=("serve.dispatch",)):
+                f_tight = eng.submit(tight, deadline_s=0.15)
+                f_slack = eng.submit(slack, deadline_s=30.0)
+                with pytest.raises(Overloaded) as exc:
+                    f_tight.result(timeout=30)
+                assert exc.value.reason == "deadline"
+                out = np.asarray(f_slack.result(timeout=30))
+            ref = np.asarray(
+                pip_join(slack, None, grid, RES, chip_index=index,
+                         recheck=False)
+            )
+            np.testing.assert_array_equal(out, ref)
+            m = eng.metrics()
+            assert m["shed"] == 1 and m["shed_deadline"] == 1
+            assert m["completed"] == 1
+
+    def test_expired_before_dispatch_is_shed_without_device_work(
+        self, index, grid
+    ):
+        with make_engine(index, grid, max_wait_s=0.05) as eng:
+            eng.warmup()
+            batches_before = eng.metrics()["batches"]
+            f = eng.submit(
+                rand_points(np.random.default_rng(2), 10),
+                deadline_s=0.0,  # already expired at formation
+            )
+            with pytest.raises(Overloaded) as exc:
+                f.result(timeout=30)
+            assert exc.value.reason == "deadline"
+            assert eng.metrics()["batches"] == batches_before
+
+    def test_queue_full_sheds_with_typed_overloaded(self, index, grid):
+        """With the queue at capacity behind a stalled dispatch, admission
+        refuses instead of queueing without bound."""
+        with make_engine(
+            index, grid, queue_capacity=2, max_wait_s=0.0
+        ) as eng:
+            eng.warmup()
+            rng = np.random.default_rng(5)
+            with telemetry.capture() as events, faults.stalls(
+                0.7, n=1, sites=("serve.dispatch",)
+            ):
+                futs = [
+                    eng.submit(rand_points(rng, 8), deadline_s=30.0)
+                ]  # occupies the worker (stalled)
+                time.sleep(0.1)
+                shed = 0
+                for _ in range(6):
+                    try:
+                        futs.append(
+                            eng.submit(rand_points(rng, 8), deadline_s=30.0)
+                        )
+                    except Overloaded as e:
+                        assert e.reason == "queue_full"
+                        assert e.capacity == 2
+                        shed += 1
+                assert shed >= 1
+                for f in futs:
+                    f.result(timeout=30)
+            assert eng.metrics()["shed"] >= shed
+            assert any(
+                e["event"] == "serve_shed"
+                and e.get("reason") == "queue_full"
+                for e in events
+            )
+
+
+class TestQuarantine:
+    def test_poison_request_leaves_batchmates_untouched(self, index, grid):
+        """A co-batched request carrying NaN/out-of-bounds rows is parked
+        through runtime/quarantine.py; its batchmates' bits are identical
+        to a poison-free run and the poisoned rows answer -1."""
+        rng = np.random.default_rng(13)
+        clean_a = rand_points(rng, 120)
+        clean_b = rand_points(rng, 77)
+        poison = rand_points(rng, 60)
+        poison[5] = np.nan
+        poison[17, 0] = np.inf
+        poison[33] = (1e6, 1e6)  # far outside BBOX bounds
+        with make_engine(index, grid, max_wait_s=0.05) as eng:
+            eng.warmup()
+            fa = eng.submit(clean_a, deadline_s=30.0)
+            fp = eng.submit(poison, deadline_s=30.0)
+            fb = eng.submit(clean_b, deadline_s=30.0)
+            out_a = np.asarray(fa.result(timeout=30))
+            out_p = np.asarray(fp.result(timeout=30))
+            out_b = np.asarray(fb.result(timeout=30))
+            m = eng.metrics()
+        for pts, out in ((clean_a, out_a), (clean_b, out_b)):
+            ref = np.asarray(
+                pip_join(pts, None, grid, RES, chip_index=index,
+                         recheck=False)
+            )
+            np.testing.assert_array_equal(out, ref)
+        assert out_p[5] == -1 and out_p[17] == -1 and out_p[33] == -1
+        good = np.ones(60, bool)
+        good[[5, 17, 33]] = False
+        ref_p = np.asarray(
+            pip_join(poison[good], None, grid, RES, chip_index=index,
+                     recheck=False)
+        )
+        np.testing.assert_array_equal(out_p[good], ref_p)
+        assert m["quarantined"] == 3
+        assert m["poisoned_requests"] == 1
+
+    def test_corrupt_injection_at_admit_site(self, index, grid):
+        """`faults.corrupt_batches` at serve.admit poisons rows before
+        scrubbing — exactly those rows must be parked."""
+        with make_engine(index, grid) as eng:
+            eng.warmup()
+            with faults.corrupt_batches(4, sites=("serve.admit",)):
+                out = np.asarray(
+                    eng.join(
+                        rand_points(np.random.default_rng(1), 30),
+                        deadline_s=30.0,
+                    )
+                )
+            assert (out[:4] == -1).all()
+            assert eng.metrics()["quarantined"] == 4
+
+
+class TestResilience:
+    def test_transient_dispatch_failure_retries_to_success(
+        self, index, grid, monkeypatch
+    ):
+        monkeypatch.setenv("MOSAIC_RETRY_BASE_S", "0.01")
+        rng = np.random.default_rng(21)
+        pts = rand_points(rng, 90)
+        with make_engine(index, grid) as eng:
+            eng.warmup()
+            with telemetry.capture() as events, faults.transient_errors(
+                1, sites=("serve.dispatch",)
+            ):
+                out = np.asarray(eng.join(pts, deadline_s=30.0))
+        ref = np.asarray(
+            pip_join(pts, None, grid, RES, chip_index=index, recheck=False)
+        )
+        np.testing.assert_array_equal(out, ref)
+        assert any(e["event"] == "transient_retry" for e in events)
+
+    def test_retry_exhaustion_degrades_to_host_oracle(
+        self, index, grid, monkeypatch
+    ):
+        monkeypatch.setenv("MOSAIC_RETRY_ATTEMPTS", "2")
+        monkeypatch.setenv("MOSAIC_RETRY_BASE_S", "0.01")
+        rng = np.random.default_rng(23)
+        pts = rand_points(rng, 70)
+        with make_engine(index, grid) as eng:
+            eng.warmup()
+            with faults.transient_errors(10, sites=("serve.dispatch",)):
+                out = eng.join(pts, deadline_s=30.0)
+        assert isinstance(out, DegradedResult)
+        ref = np.asarray(
+            pip_join(pts, None, grid, RES, chip_index=index, recheck=False)
+        )
+        np.testing.assert_array_equal(np.asarray(out), ref)
+        assert eng.metrics()["degraded"] == 1
+
+
+class TestJoinCacheHatch:
+    def test_stats_and_clear(self):
+        with telemetry.capture() as events:
+            stats = join_cache_stats()
+            cleared = clear_join_caches()
+        assert stats["cells_prog"]["maxsize"] == 64
+        assert cleared["cells_prog"]["currsize"] >= 0
+        assert join_cache_stats(emit=False)["cells_prog"]["currsize"] == 0
+        names = [e["event"] for e in events]
+        assert "join_cache_stats" in names
+        assert "join_caches_cleared" in names
+
+
+class TestSummarize:
+    def test_percentiles(self):
+        events = [
+            {"event": "serve_request", "seconds": s}
+            for s in (0.01, 0.02, 0.03, 0.04, 0.10)
+        ] + [{"event": "other", "seconds": 99.0}, {"event": "serve_request"}]
+        s = telemetry.summarize(events, event="serve_request")
+        assert s["count"] == 5
+        assert s["p50"] == 0.03
+        assert s["max"] == 0.10
+        assert s["p99"] == 0.10
+
+    def test_empty(self):
+        s = telemetry.summarize([], event="x")
+        assert s == {
+            "count": 0, "p50": 0.0, "p90": 0.0, "p99": 0.0,
+            "mean": 0.0, "max": 0.0, "sum": 0.0,
+        }

@@ -1,0 +1,260 @@
+"""Online-serving load generator: the request-facing twin of
+`tools/stream_bench.py`.
+
+Drives a :class:`mosaic_tpu.serve.ServeEngine` (resident zone index,
+warmed bucket ladder) with either load model:
+
+- **closed loop** (``--mode closed``): ``--concurrency`` workers each
+  submit their next request the moment the previous one resolves — the
+  saturation throughput measurement;
+- **open loop** (``--mode open``): requests arrive on a Poisson clock at
+  ``--rate`` req/s regardless of completions — the overload measurement.
+  When the arrival rate exceeds capacity the engine must SHED (typed
+  ``Overloaded`` at admission or deadline expiry), never queue without
+  bound: the shed rate and the p99 of *admitted* requests are the
+  headline here.
+
+Reported (last stdout line is ALWAYS one machine-parseable JSON object;
+everything else goes to stderr): request + row throughput, latency
+percentiles of admitted requests (`telemetry.summarize` over the
+engine's ``serve_request`` events — the same helper stream_bench uses),
+batch occupancy, shed/quarantine counters, and the compile story
+(ladder size, warmup signatures, cold compiles after warmup, backend
+compile count when jax's monitoring hook is available).
+
+CPU CI smoke:
+  JAX_PLATFORMS=cpu MOSAIC_BENCH_PLATFORM=cpu python tools/serve_bench.py \
+      --mode closed --requests 200 --concurrency 8 --rows-max 512
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=("closed", "open"), default="closed")
+    ap.add_argument("--requests", type=int, default=500)
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="closed-loop worker count")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open-loop arrival rate, requests/sec")
+    ap.add_argument("--rows-min", type=int, default=1)
+    ap.add_argument("--rows-max", type=int, default=1024)
+    ap.add_argument("--deadline-ms", type=float, default=2000.0)
+    ap.add_argument("--window-ms", type=float, default=2.0,
+                    help="micro-batch max-wait window")
+    ap.add_argument("--max-batch", type=int, default=16384)
+    ap.add_argument("--queue-cap", type=int, default=64)
+    ap.add_argument("--min-bucket", type=int, default=64)
+    ap.add_argument("--max-bucket", type=int, default=16384)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--poison", type=int, default=0,
+                    help="inject N NaN rows into one request "
+                    "(quarantine demo lane)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    # the LAST stdout line must be the JSON artifact
+    emit_to = sys.stdout
+    sys.stdout = sys.stderr
+
+    t_all = time.perf_counter()
+    detail: dict = {}
+    line = {
+        "metric": "serve_throughput",
+        "value": 0.0,
+        "unit": "requests/sec",
+        "detail": detail,
+    }
+    try:
+        if os.environ.get("MOSAIC_BENCH_PLATFORM") == "cpu":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        import jax
+
+        from bench import RES, _load_or_build_index, _load_zones
+        from mosaic_tpu.core.index.h3 import H3IndexSystem
+        from mosaic_tpu.runtime import telemetry
+        from mosaic_tpu.runtime.errors import Overloaded
+        from mosaic_tpu.serve import BucketLadder, ServeEngine
+        from mosaic_tpu.sql.join import join_cache_stats
+
+        h3 = H3IndexSystem()
+        zones, zones_src = _load_zones()
+        b = zones.bounds()
+        bbox = (
+            float(np.nanmin(b[:, 0])), float(np.nanmin(b[:, 1])),
+            float(np.nanmax(b[:, 2])), float(np.nanmax(b[:, 3])),
+        )
+        index, _, _ = _load_or_build_index(zones, zones_src, h3)
+        detail.update(
+            device=str(jax.devices()[0]), zones=zones_src, mode=args.mode,
+        )
+
+        engine = ServeEngine(
+            index, h3, RES,
+            ladder=BucketLadder(args.min_bucket, args.max_bucket),
+            max_batch_rows=args.max_batch,
+            max_wait_s=args.window_ms / 1e3,
+            queue_capacity=args.queue_cap,
+            default_deadline_s=args.deadline_ms / 1e3,
+            bounds=bbox,
+        )
+        t0 = time.perf_counter()
+        warm = engine.warmup()
+        detail["warmup"] = dict(warm, wall_s=round(
+            time.perf_counter() - t0, 3))
+
+        rng = np.random.default_rng(args.seed)
+        sizes = rng.integers(
+            args.rows_min, args.rows_max + 1, args.requests
+        )
+        reqs = [
+            rng.uniform(bbox[:2], bbox[2:], (int(n), 2)) for n in sizes
+        ]
+        if args.poison and reqs:
+            reqs[0][: args.poison] = np.nan
+
+        shed_submit = 0
+        shed_lock = threading.Lock()
+        futures: list = []
+
+        with telemetry.capture() as events:
+            # capture sinks are thread-local: closed-loop workers adopt
+            # the main thread's so their serve_request events land here
+            main_sinks = telemetry.current_sinks()
+            t_load = time.perf_counter()
+            if args.mode == "closed":
+                cursor = {"i": 0}
+                cursor_lock = threading.Lock()
+
+                def worker():
+                    nonlocal shed_submit
+                    telemetry.adopt_sinks(main_sinks)
+                    while True:
+                        with cursor_lock:
+                            i = cursor["i"]
+                            if i >= len(reqs):
+                                return
+                            cursor["i"] = i + 1
+                        try:
+                            f = engine.submit(reqs[i])
+                            with shed_lock:
+                                futures.append(f)
+                            try:
+                                f.result()
+                            except Overloaded:
+                                pass
+                        except Overloaded:
+                            with shed_lock:
+                                shed_submit += 1
+
+                threads = [
+                    threading.Thread(target=worker, daemon=True)
+                    for _ in range(max(args.concurrency, 1))
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            else:
+                # open loop: Poisson arrivals at --rate, submits never
+                # wait on completions; at overload the engine sheds
+                next_t = time.perf_counter()
+                for pts in reqs:
+                    next_t += float(rng.exponential(1.0 / args.rate))
+                    lag = next_t - time.perf_counter()
+                    if lag > 0:
+                        time.sleep(lag)
+                    try:
+                        futures.append(engine.submit(pts))
+                    except Overloaded:
+                        shed_submit += 1
+                for f in futures:
+                    try:
+                        f.result()
+                    except Overloaded:
+                        pass
+            load_wall = time.perf_counter() - t_load
+
+        m = engine.metrics()
+        lat = telemetry.summarize(events, event="serve_request")
+        stages = telemetry.summarize(events, event="serve_stage")
+        completed_rows = int(
+            sum(
+                e.get("rows", 0)
+                for e in events
+                if e.get("event") == "serve_request"
+            )
+        )
+        admitted = len(futures)
+        line["value"] = round(m["completed"] / max(load_wall, 1e-9), 1)
+        detail.update(
+            requests=args.requests,
+            admitted=admitted,
+            completed=m["completed"],
+            shed_submit=shed_submit,
+            shed_deadline=m["shed_deadline"],
+            shed_total=shed_submit + m["shed_deadline"],
+            shed_rate=round(
+                (shed_submit + m["shed_deadline"]) / max(args.requests, 1),
+                4,
+            ),
+            quarantined=m["quarantined"],
+            degraded=m["degraded"],
+            load_wall_s=round(load_wall, 3),
+            requests_per_sec=line["value"],
+            rows_per_sec=round(completed_rows / max(load_wall, 1e-9), 1),
+            latency=lat,
+            deadline_s=args.deadline_ms / 1e3,
+            p99_under_deadline=bool(lat["p99"] <= args.deadline_ms / 1e3),
+            batches=m["batches"],
+            occupancy_mean=m["occupancy_mean"],
+            requests_per_batch=round(
+                m["batched_requests"] / max(m["batches"], 1), 2
+            ),
+            stage_summary=stages,
+            compiles={
+                "buckets": len(engine.ladder.buckets),
+                "warmup_signatures": warm["signatures"],
+                "cold_compiles": m["cold_compiles"],
+                "backend_compiles_warmup": warm.get("backend_compiles"),
+            },
+            join_cache=join_cache_stats(emit=False),
+        )
+        engine.close()
+    except Exception as e:  # the artifact line must still parse
+        detail["error"] = repr(e)[:400]
+        try:
+            import jax as _j
+
+            detail.setdefault("device", str(_j.devices()[0]))
+        except Exception:
+            detail.setdefault("device", "unknown")
+
+    detail["total_wall_s"] = round(time.perf_counter() - t_all, 1)
+    out = json.dumps(line)
+    emit_to.write(out + "\n")
+    emit_to.flush()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+    if detail.get("error") and not line["value"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

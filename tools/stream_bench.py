@@ -389,6 +389,16 @@ def main() -> None:
     detail["stages"] = [
         s for s in stages if s.get("event") == "stream_stage"
     ]
+    # percentile rollup via the shared helper (the serve bench uses the
+    # same one for request latencies — one p99 definition everywhere)
+    try:
+        from mosaic_tpu.runtime import telemetry as _tele
+
+        detail["stage_summary"] = _tele.summarize(
+            detail["stages"], event="stream_stage"
+        )
+    except Exception:
+        pass
     detail["total_wall_s"] = round(time.perf_counter() - t_all, 1)
     out = json.dumps(line)
     emit_to.write(out + "\n")
