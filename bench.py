@@ -375,7 +375,26 @@ def main():
     emit_to = sys.stdout
     sys.stdout = sys.stderr
 
+    # MOSAIC_BENCH_TRAIL=/path.jsonl captures the full telemetry trail
+    # (join.pip spans, recheck/escalation/retry events, stage timings)
+    # and exports it at emit — feed it to tools/trace_report.py or
+    # tools/perf_gate.py
+    trail_path = os.environ.get("MOSAIC_BENCH_TRAIL")
+    trail_events: list = []
+    if trail_path:
+        from mosaic_tpu.runtime import telemetry as _telemetry
+
+        _telemetry.current_sinks().append(trail_events)
+
     def _emit(obj: dict) -> None:
+        if trail_path:
+            try:
+                from mosaic_tpu.obs import write_jsonl as _write_jsonl
+
+                _write_jsonl(trail_events, trail_path)
+                obj.setdefault("detail", {})["trail"] = trail_path
+            except Exception as e:  # the artifact line must still emit
+                obj.setdefault("detail", {})["trail_error"] = repr(e)[:200]
         obj.setdefault("detail", {}).setdefault("device", "unknown")
         emit_to.write(json.dumps(obj) + "\n")
         emit_to.flush()

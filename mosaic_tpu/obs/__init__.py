@@ -1,0 +1,89 @@
+"""Observability: tracing, typed metrics, exporters — over the
+telemetry spine.
+
+The reference Mosaic inherits Spark's UI and metrics system for free;
+this package is the TPU reproduction's equivalent, grown from (and
+backward-compatible with) `runtime/telemetry.py`'s flat event trail:
+
+- **tracing** (`obs/trace.py`) — Dapper-style spans with
+  ``trace_id``/``span_id``/``parent_id`` and explicit cross-thread
+  propagation (:func:`current_context`/:func:`adopt_context`), so one
+  serve request is ONE trace across admit → batch → dispatch →
+  scatter-back, and one durable stream run is one trace across ring
+  build → segments → snapshots → resume. Retry/escalation/watchdog/
+  degradation events are stamped with the enclosing span's ids
+  automatically;
+- **metrics** (`obs/metrics.py`) — typed counters/gauges/histograms
+  with labels (``serve.requests_shed{reason}``,
+  ``join.cap_overflows{stage}``, ``stream.hbm_peak_bytes``,
+  ``obs.compile_count{kind}``), fed by an event→metric bridge off the
+  telemetry spine plus direct gauges where no event exists;
+- **exporters** (`obs/export.py`) — JSONL trails, Chrome trace-event
+  JSON (Perfetto-loadable; the host-side complement of the xprof
+  device traces), Prometheus text exposition.
+
+Tools: `tools/trace_report.py` renders/diffs per-stage latency
+breakdowns from trails; `tools/perf_gate.py` is the CI regression gate
+over committed stage-share goldens (`tests/goldens/perf_gate.json`).
+
+Importing this package registers the tracer and the metric bridge with
+`runtime/telemetry.py`; until then the runtime pays nothing for either.
+"""
+
+from . import export, metrics, trace
+from .export import (
+    chrome_trace,
+    prometheus_text,
+    read_trail,
+    trace_summary,
+    write_chrome_trace,
+    write_jsonl,
+)
+from .metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    counter,
+    gauge,
+    histogram,
+    snapshot,
+)
+from .trace import (
+    Span,
+    SpanContext,
+    adopt_context,
+    current_context,
+    span,
+    start_span,
+)
+
+metrics.install_bridge()
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "Span",
+    "SpanContext",
+    "adopt_context",
+    "chrome_trace",
+    "counter",
+    "current_context",
+    "export",
+    "gauge",
+    "histogram",
+    "metrics",
+    "prometheus_text",
+    "read_trail",
+    "snapshot",
+    "span",
+    "start_span",
+    "trace",
+    "trace_summary",
+    "write_chrome_trace",
+    "write_jsonl",
+]

@@ -14,7 +14,8 @@ entry points instead:
 - :mod:`retry`      — bounded transient-failure retry with exponential
   backoff + jitter and an optional host-oracle fallback (degradation);
 - :mod:`telemetry`  — structured events every escalation/retry/degradation
-  emits (capturable in tests, logged via `utils.get_logger`);
+  emits (capturable in tests; logging is opt-in via `utils.get_logger`,
+  and the `mosaic_tpu.obs` tracer/metrics layers register here);
 - :mod:`faults`     — context-manager fault injection (shrunken caps,
   synthetic transient errors, simulated stalls, corrupted batches)
   exercising all of the above for real;

@@ -30,6 +30,7 @@ import hashlib
 import json
 import os
 import re
+import time
 
 import numpy as np
 
@@ -66,6 +67,7 @@ def save_snapshot(
     lands only after the npz, so a sidecar's existence implies a
     complete npz was on disk at write time.
     """
+    t0 = time.perf_counter()
     os.makedirs(run_dir, exist_ok=True)
     npz_path, json_path = _snap_paths(run_dir, step)
     tmp_npz = npz_path + ".tmp"
@@ -86,6 +88,7 @@ def save_snapshot(
     telemetry.record(
         "snapshot_saved", run_dir=run_dir, step=int(step),
         bytes=os.path.getsize(npz_path), sha256=digest[:12],
+        seconds=round(time.perf_counter() - t0, 6),
     )
     return npz_path
 

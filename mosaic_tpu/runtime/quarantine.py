@@ -216,7 +216,12 @@ def find_park_point(
             (xmin - m * w, ymax + m * h),
         ]
     cand = np.asarray(cand, dtype=np.float64)
-    cells = np.asarray(assign(cand))
+    # the one device round-trip in the quarantine path — timed so park
+    # searches show up in trails (and attach to the admitting span)
+    with telemetry.timed(
+        "quarantine_stage", stage="park_search", candidates=len(cand),
+    ):
+        cells = np.asarray(assign(cand))
     indexed = np.isin(cells, np.asarray(index_cells))
     ok = np.nonzero(~indexed & np.isfinite(cand).all(axis=1))[0]
     if ok.size == 0:

@@ -2,19 +2,35 @@
 
 Every escalation attempt, transient retry, fault injection, and
 degradation emits one flat event dict here. Events always go to the
-`mosaic_tpu.runtime` logger; tests and services additionally subscribe
+`mosaic_tpu.runtime` logger — but only when that logger is actually
+enabled (see :func:`record`); tests and services additionally subscribe
 with :func:`capture` to assert on (or export) the exact trail — the
 acceptance contract is that resilience is *visible*, never silent.
+
+Observability hooks (`mosaic_tpu/obs/`): this module stays the ONE
+event spine, and the obs subsystem layers on top of it through two
+registration points rather than a parallel pipeline:
+
+- :func:`register_tracer` — the tracer stamps every event with the
+  active ``trace_id``/``span_id`` (explicit fields win), and
+  :func:`current_trace`/:func:`adopt_trace` let worker threads carry
+  the caller's span context the same way :func:`current_sinks`/
+  :func:`adopt_sinks` carry capture scopes;
+- :func:`add_observer` — process-wide event observers (the obs metrics
+  bridge) see every event after the thread-local sinks do.
+
+Both are no-ops until ``mosaic_tpu.obs`` is imported, so the runtime
+layer never depends on the observability layer.
 """
 
 from __future__ import annotations
 
 import contextlib
 import itertools
+import logging
+import math
 import threading
 import time
-
-from ..utils import get_logger
 
 _LOCAL = threading.local()
 
@@ -22,6 +38,22 @@ _LOCAL = threading.local()
 #: GIL, so concurrent recorders (watchdog workers, stream threads) still
 #: get unique, strictly increasing numbers
 _SEQ = itertools.count()
+
+#: the runtime event logger, resolved ONCE — ``utils.get_logger`` force-
+#: installs a handler at INFO, which made every record() format and emit
+#: a log line even with no sinks and no one reading; record() now guards
+#: with ``isEnabledFor`` so an app must opt in (configure the logger or
+#: call ``utils.get_logger``) before events cost any formatting
+_LOGGER = logging.getLogger("mosaic_tpu.runtime")
+
+#: registered by ``mosaic_tpu.obs.trace`` — an object with
+#: ``ids() -> dict | None``, ``current() -> context | None``, and
+#: ``adopt(context) -> None``; None until the obs subsystem is imported
+_TRACER = None
+
+#: process-wide event observers (``fn(evt) -> None``) — the obs metrics
+#: bridge registers here; observers must be cheap and non-raising
+_OBSERVERS: list = []
 
 
 def _sinks() -> list:
@@ -45,6 +77,42 @@ def adopt_sinks(sinks: list) -> None:
     _LOCAL.sinks = sinks
 
 
+def register_tracer(tracer) -> None:
+    """Install the span-context provider (``mosaic_tpu.obs.trace`` calls
+    this at import). ``tracer.ids()`` returns ``{"trace_id": ...,
+    "span_id": ...}`` when a span is active on the calling thread."""
+    global _TRACER
+    _TRACER = tracer
+
+
+def current_trace():
+    """The calling thread's active span context (opaque; hand it to
+    :func:`adopt_trace` on a worker), or None when no tracer is
+    registered or no span is active."""
+    return None if _TRACER is None else _TRACER.current()
+
+
+def adopt_trace(context) -> None:
+    """Adopt a :func:`current_trace` result on this thread so events
+    recorded here attach to the caller's span (no-op without a
+    tracer or with ``context=None``)."""
+    if _TRACER is not None and context is not None:
+        _TRACER.adopt(context)
+
+
+def add_observer(fn) -> None:
+    """Register a process-wide event observer (``fn(evt)``); every
+    :func:`record` call reaches it after the thread-local sinks."""
+    if fn not in _OBSERVERS:
+        _OBSERVERS.append(fn)
+
+
+def remove_observer(fn) -> None:
+    """Unregister an :func:`add_observer` observer (idempotent)."""
+    if fn in _OBSERVERS:
+        _OBSERVERS.remove(fn)
+
+
 def record(event: str, **fields) -> dict:
     """Emit one structured event: ``{"event": event, "seq": n,
     "ts_mono": t, **fields}``.
@@ -56,6 +124,15 @@ def record(event: str, **fields) -> dict:
     assert ordering (a retry precedes its degradation; a snapshot save
     precedes the resume that reads it) instead of guessing from list
     position across capture scopes.
+
+    When a tracer is registered (``mosaic_tpu.obs``) and a span is
+    active on this thread, the event is stamped with ``trace_id``/
+    ``span_id`` — explicitly passed fields win, so span-end events
+    carry their own ids untouched.
+
+    Hot-path cost contract: with no sinks, no observers, and the
+    ``mosaic_tpu.runtime`` logger disabled, record() performs NO string
+    formatting and emits nothing (pinned by tests/test_obs.py).
     """
     evt = {
         "event": event,
@@ -63,9 +140,16 @@ def record(event: str, **fields) -> dict:
         "ts_mono": round(time.monotonic(), 6),
         **fields,
     }
+    if _TRACER is not None and "trace_id" not in evt:
+        ids = _TRACER.ids()
+        if ids is not None:
+            evt.update(ids)
     for sink in _sinks():
         sink.append(evt)
-    get_logger("mosaic_tpu.runtime").info("%s %s", event, fields)
+    for obs in _OBSERVERS:
+        obs(evt)
+    if _LOGGER.isEnabledFor(logging.INFO):
+        _LOGGER.info("%s %s", event, fields)
     return evt
 
 
@@ -77,15 +161,25 @@ def timed(event: str, **fields):
     (ring build, compile, join loop, generator loop, narrow recheck)
     emits exactly one event whose ``seconds`` is non-negative wall time —
     benches embed the captured trail verbatim in their JSON artifacts.
+
+    A block that raises still records its event — stamped with
+    ``error=<exception type name>`` (and the exception re-raises), so a
+    failed stage is distinguishable from a fast success in any trail.
     """
     t0 = time.perf_counter()
+    err: str | None = None
     try:
         yield
+    except BaseException as e:  # noqa: BLE001 — stamped and re-raised
+        err = type(e).__name__
+        raise
     finally:
+        extra = {} if err is None else {"error": err}
         record(
             event,
             seconds=round(max(time.perf_counter() - t0, 0.0), 6),
             **fields,
+            **extra,
         )
 
 
@@ -101,6 +195,13 @@ def summarize(
     share (`tools/serve_bench.py` latencies, `tools/stream_bench.py`
     stage timings) — a p99 computed two different ad-hoc ways is two
     different metrics.
+
+    Percentiles are explicit nearest-rank (``ceil(q*n) - 1`` on the
+    sorted sample): the q-th percentile is the smallest value with at
+    least ``q*n`` samples at or below it. The previous
+    ``int(round(q*(n-1)))`` spelling rode Python's banker's rounding,
+    which drifts ranks for small n (n=4 p50 returned the 3rd value, not
+    the 2nd) — exact-rank tests in tests/test_obs.py pin the definition.
     """
     vals = [
         float(e[key])
@@ -116,8 +217,8 @@ def summarize(
     n = len(vals)
 
     def pct(q: float) -> float:
-        # nearest-rank on the sorted sample: stable for tiny n
-        return vals[min(n - 1, max(0, int(round(q * (n - 1)))))]
+        # nearest-rank: smallest index covering ceil(q*n) samples
+        return vals[min(n - 1, max(0, math.ceil(q * n) - 1))]
 
     return {
         "count": n,

@@ -93,10 +93,12 @@ def guard(site: str, fn, *args, default_s: float | None = None, **kwargs):
     done = threading.Event()
     box: dict = {}
     sinks = telemetry.current_sinks()  # capture scopes span the worker
+    trace = telemetry.current_trace()  # the active span does too
 
     def work():
         try:
             telemetry.adopt_sinks(sinks)
+            telemetry.adopt_trace(trace)
             if stall_s:
                 time.sleep(stall_s)
             box["value"] = fn(*args, **kwargs)

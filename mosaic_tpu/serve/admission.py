@@ -35,6 +35,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..obs import metrics as _metrics, trace as _trace
 from ..runtime import (
     faults as _faults,
     quarantine as _quarantine,
@@ -58,6 +59,12 @@ class Request:
     #: thread-local in their modules)
     sinks: list = dataclasses.field(default_factory=list)
     plans: list = dataclasses.field(default_factory=list)
+    #: the request's trace — ``span`` is the ``serve.request`` root
+    #: (begun at admission, ended at scatter-back/shed), ``ctx`` its
+    #: :class:`~mosaic_tpu.obs.trace.SpanContext` the batcher thread
+    #: adopts so dispatch-side spans join the submitter's trace
+    span: "_trace.Span | None" = None
+    ctx: "_trace.SpanContext | None" = None
 
     def remaining(self, now: float | None = None) -> float:
         if self.deadline is None:
@@ -121,6 +128,24 @@ class AdmissionController:
             raise ValueError(f"expected (n, 2) points, got {raw.shape}")
         self.metrics["submitted"] += 1
 
+        # the request's trace root: begun here on the submit thread,
+        # ended at scatter-back (or shed) on the batcher thread — the
+        # request's whole lifecycle is ONE span, its stages children
+        root = _trace.start_span(
+            "serve.request", detached=True, rows=int(raw.shape[0]),
+        )
+        try:
+            with _trace.span(
+                "serve.admit", parent=root.context, rows=int(raw.shape[0]),
+            ):
+                return self._admit_scrubbed(raw, deadline_s, root)
+        except BaseException as e:  # noqa: BLE001 — span closed, re-raised
+            root.end(error=type(e).__name__)
+            raise
+
+    def _admit_scrubbed(
+        self, raw: np.ndarray, deadline_s: float | None, root
+    ) -> Request:
         report = None
         parked = 0
         bad, reasons = _quarantine.scrub_points(raw, bounds=self.bounds)
@@ -151,6 +176,8 @@ class AdmissionController:
             quarantine=report,
             sinks=_telemetry.current_sinks(),
             plans=_faults.current_plans(),
+            span=root,
+            ctx=root.context,
         )
         with self._not_empty:
             depth = len(self._queue)
@@ -169,6 +196,7 @@ class AdmissionController:
                 )
             self._queue.append(req)
             self.metrics["admitted"] += 1
+            _metrics.gauge("serve.queue_depth").set(len(self._queue))
             self._not_empty.notify()
         return req
 
@@ -194,7 +222,9 @@ class AdmissionController:
                 self._not_empty.wait(timeout)
             if not self._queue:
                 return None
-            return self._queue.pop(0)
+            req = self._queue.pop(0)
+            _metrics.gauge("serve.queue_depth").set(len(self._queue))
+            return req
 
     def put_back(self, req: Request) -> None:
         """Return a request to the queue HEAD (the batcher overshot its

@@ -38,6 +38,7 @@ import time
 
 import numpy as np
 
+from ..obs import trace as _trace
 from ..runtime import faults as _faults, telemetry as _telemetry
 from ..runtime.errors import DegradedResult, Overloaded
 from .admission import AdmissionController, Request
@@ -126,10 +127,13 @@ class MicroBatcher:
 
     def _process(self, batch: list[Request]) -> None:
         # the dispatch worker adopts the FIRST request's caller context:
-        # fault plans and capture sinks are thread-local, and tests
-        # install them on the submitting thread
+        # fault plans, capture sinks, and span context are thread-local,
+        # and tests install them on the submitting thread (batchmates
+        # from other traces keep their OWN root spans; only the shared
+        # batch/dispatch spans parent to the first request's trace)
         _telemetry.adopt_sinks(batch[0].sinks)
         _faults.adopt_plans(batch[0].plans)
+        _trace.adopt_context(batch[0].ctx)
 
         now = time.monotonic()
         live = []
@@ -146,7 +150,9 @@ class MicroBatcher:
         self.metrics["batched_rows"] += rows
         self.metrics["batched_requests"] += len(live)
         try:
-            with _telemetry.timed(
+            with _trace.span(
+                "serve.batch", requests=len(live), rows=rows,
+            ), _telemetry.timed(
                 "serve_stage", stage="batch", requests=len(live), rows=rows,
             ):
                 _faults.maybe_fail("serve.batch")
@@ -181,13 +187,18 @@ class MicroBatcher:
                 )
                 self.metrics["degraded"] += 1
             self.metrics["completed"] += 1
+            # the event and the root-span close both carry the REQUEST's
+            # own trace ids — the ambient context here is batch[0]'s
             _telemetry.record(
                 "serve_request",
                 seconds=round(now - req.t_submit, 6),
                 rows=req.n,
                 parked=req.parked,
                 degraded=bool(degraded),
+                **_req_ids(req),
             )
+            if req.span is not None:
+                req.span.end(degraded=bool(degraded), parked=req.parked)
             req.future.set_result(sl)
 
     def _shed(self, req: Request, reason: str) -> None:
@@ -196,7 +207,10 @@ class MicroBatcher:
         _telemetry.record(
             "serve_shed", reason=reason, rows=req.n,
             elapsed_s=round(elapsed, 6),
+            **_req_ids(req),
         )
+        if req.span is not None:
+            req.span.end(error="Overloaded", reason=reason)
         req.future.set_exception(
             Overloaded(
                 f"request shed ({reason}) after {elapsed:.3f}s",
@@ -212,4 +226,14 @@ class MicroBatcher:
 
     def _fail(self, req: Request, exc: BaseException) -> None:
         self.metrics["failed"] += 1
+        if req.span is not None:
+            req.span.end(error=type(exc).__name__)
         req.future.set_exception(exc)
+
+
+def _req_ids(req: Request) -> dict:
+    """Explicit trace stamps for per-request events recorded while the
+    thread's ambient context belongs to another batchmate."""
+    if req.ctx is None:
+        return {}
+    return {"trace_id": req.ctx.trace_id, "span_id": req.ctx.span_id}

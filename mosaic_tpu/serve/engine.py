@@ -43,6 +43,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..obs import trace as _trace
 from ..runtime import telemetry as _telemetry, watchdog as _watchdog
 from ..runtime.retry import call_with_retry
 from ..sql import join as _join
@@ -170,7 +171,9 @@ class ServeEngine:
         tripwire."""
         t0 = backend_compiles()
         total = 0.0
-        with _telemetry.capture() as events:
+        with _telemetry.capture() as events, _trace.span(
+            "serve.warmup", buckets=len(self.ladder.buckets)
+        ):
             for b in self.ladder.buckets:
                 pts = np.zeros((b, 2), dtype=np.float64)
                 with _telemetry.timed(
@@ -228,7 +231,9 @@ class ServeEngine:
         Returns ``(results (n,), occupancy)``."""
         padded, n = self.ladder.pad(points)
         bucket = padded.shape[0]
-        with _telemetry.timed(
+        with _trace.span(
+            "serve.dispatch", bucket=bucket, rows=n,
+        ), _telemetry.timed(
             "serve_stage", stage="dispatch", bucket=bucket, rows=n,
         ):
             out = self._dispatch_resilient(padded, deadline_hint)

@@ -50,6 +50,7 @@ from ..core.geometry.device import (
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
+from ..obs import trace as _obs_trace
 from ..runtime import (
     faults as _faults,
     telemetry as _telemetry,
@@ -1655,20 +1656,23 @@ def pip_join(
                 attempts=e.attempts,
             )
 
-    if batch_size is None or n <= batch_size:
-        return run_resilient(raw)
-    out = np.empty(n, dtype=np.int32)
-    degraded: list[DegradedResult] = []
-    for s in range(0, n, batch_size):
-        r = run_resilient(raw[s : s + batch_size])
-        if isinstance(r, DegradedResult):
-            degraded.append(r)
-        out[s : s + batch_size] = r
-    if degraded:
-        return DegradedResult.wrap(
-            out,
-            reason=degraded[0].reason,
-            attempts=max(d.attempts for d in degraded),
-            detail={"degraded_batches": len(degraded)},
-        )
-    return out
+    # one span per pip_join call: escalation/retry/degradation/recheck
+    # events inside attach to it, so a trail shows WHICH join they hit
+    with _obs_trace.span("join.pip", n=n, recheck=bool(recheck)):
+        if batch_size is None or n <= batch_size:
+            return run_resilient(raw)
+        out = np.empty(n, dtype=np.int32)
+        degraded: list[DegradedResult] = []
+        for s in range(0, n, batch_size):
+            r = run_resilient(raw[s : s + batch_size])
+            if isinstance(r, DegradedResult):
+                degraded.append(r)
+            out[s : s + batch_size] = r
+        if degraded:
+            return DegradedResult.wrap(
+                out,
+                reason=degraded[0].reason,
+                attempts=max(d.attempts for d in degraded),
+                detail={"degraded_batches": len(degraded)},
+            )
+        return out

@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import metrics as _metrics, trace as _trace
 from ..runtime import (
     checkpoint as _checkpoint,
     faults as _faults,
@@ -91,7 +92,9 @@ def ring_from_host(batches) -> jax.Array:
     ring. Blocks until the ring is staged (staging is not loop time).
     ``stream.prefetch`` is the fault/watchdog site: staging the next
     inputs is where a tunnel drop or hang surfaces in ring rebuilds."""
-    with _telemetry.timed("stream_stage", stage="ring_build", source="host"):
+    with _trace.span("stream.ring_build", source="host"), _telemetry.timed(
+        "stream_stage", stage="ring_build", source="host"
+    ):
 
         def stage():
             ring = jnp.stack(
@@ -106,7 +109,9 @@ def ring_from_host(batches) -> jax.Array:
 def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
     """Device-generated ring: ``gen(fold_in(key, i)) -> (B, 2)`` for K
     distinct slots, stacked resident in HBM."""
-    with _telemetry.timed(
+    with _trace.span(
+        "stream.ring_build", source="device_gen", k=k
+    ), _telemetry.timed(
         "stream_stage", stage="ring_build", source="device_gen", k=k
     ):
 
@@ -137,6 +142,9 @@ def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
     for key in ("peak_bytes_in_use", "bytes_in_use", "bytes_used"):
         v = int(st.get(key, 0) or 0)
         if v > 0:
+            _metrics.gauge("stream.hbm_peak_bytes").set(
+                v, source=f"memory_stats.{key}"
+            )
             return v, f"memory_stats.{key}"
     total = 0
     try:
@@ -148,6 +156,9 @@ def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
             total += int(a.nbytes)
         except Exception:
             pass
+    _metrics.gauge("stream.hbm_peak_bytes").set(
+        total, source="live_buffer_census"
+    )
     return total, "live_buffer_census"
 
 
@@ -359,17 +370,20 @@ class StreamJoin:
         completion is forced by pulling the (3,) fold.
         """
         k, batch = int(ring.shape[0]), int(ring.shape[1])
-        t0 = time.perf_counter()
-        acc, outs = self._loop(ring, self.index, n_batches, collect)
-        acc_np = np.asarray(acc)  # blocks: the loop's only host pull
-        wall = time.perf_counter() - t0
-        n_points = n_batches * batch
-        _telemetry.record(
-            "stream_stage", stage="join_loop",
-            seconds=round(wall, 6), n_batches=n_batches, batch=batch,
-            ring_k=k, prefetch=self.prefetch,
-            points_per_sec=round(n_points / max(wall, 1e-9), 1),
-        )
+        with _trace.span(
+            "stream.run", n_batches=n_batches, batch=batch, ring_k=k,
+        ):
+            t0 = time.perf_counter()
+            acc, outs = self._loop(ring, self.index, n_batches, collect)
+            acc_np = np.asarray(acc)  # blocks: the loop's only host pull
+            wall = time.perf_counter() - t0
+            n_points = n_batches * batch
+            _telemetry.record(
+                "stream_stage", stage="join_loop",
+                seconds=round(wall, 6), n_batches=n_batches, batch=batch,
+                ring_k=k, prefetch=self.prefetch,
+                points_per_sec=round(n_points / max(wall, 1e-9), 1),
+            )
         return StreamResult(
             checksum=int(acc_np[0]),
             matches=int(acc_np[1]),
@@ -437,6 +451,11 @@ class StreamJoin:
         :func:`ring_from_host`'s. The report's counters surface in
         ``metrics`` of subsequent :meth:`run_durable` calls.
         """
+        batches = list(batches)  # materialize once (may be a generator)
+        with _trace.span("stream.admit", batches=len(batches)):
+            return self._admit_scrubbed(batches, bounds, park)
+
+    def _admit_scrubbed(self, batches, bounds, park):
         raws = [
             np.asarray(
                 _faults.maybe_corrupt("stream.admit", b), dtype=np.float64
@@ -533,6 +552,11 @@ class StreamJoin:
         never kill the run (``snapshot_skipped`` telemetry; resume
         granularity coarsens). Interrupt anywhere and
         :meth:`resume`\\ (``run_dir``, same ring) finishes the run.
+
+        Tracing: the whole run is one ``stream.durable_run`` span with
+        one child per segment and snapshot; the span's context is
+        persisted in every snapshot sidecar, so a later :meth:`resume`
+        JOINS the interrupted run's trace instead of starting a new one.
         """
         return self._run_segments(
             ring, int(n_batches), run_dir=run_dir,
@@ -605,17 +629,50 @@ class StreamJoin:
             } or None,
             watchdog_default_s=watchdog_default_s,
             retry_policy=retry_policy,
+            trace_parent=_trace.SpanContext.from_dict(meta.get("trace")),
         )
 
     def _run_segments(
         self, ring, n_batches, *, run_dir, snapshot_every, start_step,
         acc0, cells0, collect, resumed_from, extra_arrays,
-        watchdog_default_s, retry_policy,
+        watchdog_default_s, retry_policy, trace_parent=None,
     ) -> StreamResult:
         k, batch = int(ring.shape[0]), int(ring.shape[1])
         snapshot_every = max(1, snapshot_every)
         ring_np = np.asarray(ring)  # host twin: fingerprint + fallback
         ring_fp = _checkpoint.fingerprint(ring_np)
+        # one root span per durable run; a resume parents to the
+        # INTERRUPTED run's root (persisted in the snapshot sidecars),
+        # so kill + resume reads as one trace end to end
+        root = _trace.start_span(
+            "stream.durable_run",
+            parent=trace_parent,
+            n_batches=int(n_batches),
+            resumed_from=resumed_from,
+            snapshot_every=int(snapshot_every),
+        )
+        try:
+            return self._run_segments_traced(
+                ring, n_batches, run_dir=run_dir,
+                snapshot_every=snapshot_every, start_step=start_step,
+                acc0=acc0, cells0=cells0, collect=collect,
+                resumed_from=resumed_from, extra_arrays=extra_arrays,
+                watchdog_default_s=watchdog_default_s,
+                retry_policy=retry_policy, root=root,
+                ring_np=ring_np, ring_fp=ring_fp, k=k, batch=batch,
+            )
+        except BaseException as e:  # noqa: BLE001 — stamped, re-raised
+            root.set(error=type(e).__name__)
+            raise
+        finally:
+            root.end()
+
+    def _run_segments_traced(
+        self, ring, n_batches, *, run_dir, snapshot_every, start_step,
+        acc0, cells0, collect, resumed_from, extra_arrays,
+        watchdog_default_s, retry_policy, root, ring_np, ring_fp,
+        k, batch,
+    ) -> StreamResult:
         acc = (
             np.zeros(3, np.int64) if acc0 is None
             else _wrap_i32(np.asarray(acc0, np.int64))
@@ -634,6 +691,7 @@ class StreamJoin:
             "prefetch": self.prefetch,
             "snapshot_every": int(snapshot_every),
             "ring_sha256": ring_fp,
+            "trace": root.context.as_dict(),
         }
         degraded_segments = 0
         snapshots = 0
@@ -658,31 +716,32 @@ class StreamJoin:
                     np.asarray(o) if collect else None,
                 )
 
-            try:
-                a_np, cells_new, o_np = call_with_retry(
-                    lambda: _watchdog.guard(
-                        "stream.scan_step", dispatch,
-                        default_s=watchdog_default_s,
-                    ),
-                    policy=retry_policy,
-                    label="stream.scan_step",
-                )
-                acc = np.asarray(a_np, np.int64)
-                cells = cells_new
-            except RetryExhausted as e:
-                if host is None:
-                    raise
-                _telemetry.record(
-                    "degraded", label="stream.scan_step", step=step,
-                    attempts=e.attempts, error=repr(e.last)[:200],
-                )
-                delta, o_np = self._host_segment(
-                    ring_np, step, seg_n, collect
-                )
-                acc = _wrap_i32(acc + delta)
-                degraded_segments += 1
-                if self.prefetch:
-                    cells = self.assign(ring[(step + seg_n) % k])
+            with _trace.span("stream.segment", step=step, n=seg_n):
+                try:
+                    a_np, cells_new, o_np = call_with_retry(
+                        lambda: _watchdog.guard(
+                            "stream.scan_step", dispatch,
+                            default_s=watchdog_default_s,
+                        ),
+                        policy=retry_policy,
+                        label="stream.scan_step",
+                    )
+                    acc = np.asarray(a_np, np.int64)
+                    cells = cells_new
+                except RetryExhausted as e:
+                    if host is None:
+                        raise
+                    _telemetry.record(
+                        "degraded", label="stream.scan_step", step=step,
+                        attempts=e.attempts, error=repr(e.last)[:200],
+                    )
+                    delta, o_np = self._host_segment(
+                        ring_np, step, seg_n, collect
+                    )
+                    acc = _wrap_i32(acc + delta)
+                    degraded_segments += 1
+                    if self.prefetch:
+                        cells = self.assign(ring[(step + seg_n) % k])
             if collect and o_np is not None:
                 outs_list.append(o_np)
             step += seg_n
@@ -697,23 +756,24 @@ class StreamJoin:
                     run_dir, step, payload, meta
                 )
 
-            try:
-                call_with_retry(
-                    lambda: _watchdog.guard(
-                        "stream.snapshot", snap,
-                        default_s=watchdog_default_s,
-                    ),
-                    policy=retry_policy,
-                    label="stream.snapshot",
-                )
-                snapshots += 1
-            except RetryExhausted as e:
-                # durability degrades (coarser resume point), the run
-                # itself must not die for a sick disk
-                _telemetry.record(
-                    "snapshot_skipped", run_dir=run_dir, step=step,
-                    error=repr(e.last)[:200],
-                )
+            with _trace.span("stream.snapshot", step=step):
+                try:
+                    call_with_retry(
+                        lambda: _watchdog.guard(
+                            "stream.snapshot", snap,
+                            default_s=watchdog_default_s,
+                        ),
+                        policy=retry_policy,
+                        label="stream.snapshot",
+                    )
+                    snapshots += 1
+                except RetryExhausted as e:
+                    # durability degrades (coarser resume point), the
+                    # run itself must not die for a sick disk
+                    _telemetry.record(
+                        "snapshot_skipped", run_dir=run_dir, step=step,
+                        error=repr(e.last)[:200],
+                    )
         wall = time.perf_counter() - t0
         acc_w = _wrap_i32(acc)
         n_run = n_batches - start_step

@@ -1,12 +1,14 @@
 """Serving-engine contract (PR 4): shape-bucketed compile discipline,
 co-batched bit-identity, deadline shedding, backpressure, quarantine
-isolation, and degradation — `mosaic_tpu/serve/`."""
+isolation, and degradation — `mosaic_tpu/serve/`. PR 5 adds the trace
+contract: one request, one connected trace across threads."""
 
 import time
 
 import numpy as np
 import pytest
 
+from mosaic_tpu import obs
 from mosaic_tpu.core.geometry import wkt
 from mosaic_tpu.core.index import CustomIndexSystem, GridConf
 from mosaic_tpu.core.tessellate import tessellate
@@ -317,6 +319,115 @@ class TestJoinCacheHatch:
         names = [e["event"] for e in events]
         assert "join_cache_stats" in names
         assert "join_caches_cleared" in names
+
+
+class TestTracing:
+    def test_one_request_is_one_connected_trace(self, index, grid):
+        """A request submitted on the test thread and dispatched on the
+        batcher thread yields ONE trace: every span shares the
+        trace_id, parent links resolve, no orphans — admit (submit
+        thread) through batch/dispatch (batcher thread) to the
+        request-root close at scatter-back."""
+        with make_engine(index, grid) as eng:
+            eng.warmup()
+            with telemetry.capture() as events:
+                out = eng.join(
+                    rand_points(np.random.default_rng(31), 25),
+                    deadline_s=30.0,
+                )
+        assert np.asarray(out).shape == (25,)
+        spans = [e for e in events if e["event"] == "span"]
+        by_name = {s["name"]: s for s in spans}
+        assert set(by_name) >= {
+            "serve.request", "serve.admit", "serve.batch",
+            "serve.dispatch",
+        }
+        summ = obs.trace_summary(events)
+        assert len(summ) == 1, f"expected ONE trace, got {summ}"
+        ((tid, t),) = summ.items()
+        assert t["roots"] == 1 and not t["orphans"], t
+        root = by_name["serve.request"]
+        assert root["parent_id"] is None and root["trace_id"] == tid
+        assert by_name["serve.admit"]["parent_id"] == root["span_id"]
+        assert by_name["serve.batch"]["parent_id"] == root["span_id"]
+        assert (
+            by_name["serve.dispatch"]["parent_id"]
+            == by_name["serve.batch"]["span_id"]
+        )
+        # the per-request latency event carries the same trace
+        req_ev = next(e for e in events if e["event"] == "serve_request")
+        assert req_ev["trace_id"] == tid
+
+    def test_batchmates_keep_their_own_traces(self, index, grid):
+        """Co-batched requests stay separate traces; each request's
+        serve_request event and root span carry its OWN trace_id."""
+        with make_engine(index, grid, max_wait_s=0.05) as eng:
+            eng.warmup()
+            rng = np.random.default_rng(33)
+            with telemetry.capture() as events:
+                futs = [
+                    eng.submit(rand_points(rng, 30), deadline_s=30.0)
+                    for _ in range(3)
+                ]
+                for f in futs:
+                    f.result(timeout=30)
+            assert eng.metrics()["batches"] < 3  # really coalesced
+        roots = [
+            e for e in events
+            if e["event"] == "span" and e["name"] == "serve.request"
+        ]
+        assert len(roots) == 3
+        assert len({r["trace_id"] for r in roots}) == 3
+        req_evs = [e for e in events if e["event"] == "serve_request"]
+        assert sorted(e["trace_id"] for e in req_evs) == sorted(
+            r["trace_id"] for r in roots
+        )
+
+    def test_retry_attaches_to_the_request_trace(self, index, grid,
+                                                 monkeypatch):
+        """A transient dispatch failure's retry events land INSIDE the
+        request's trace — the causal link the flat trail never had."""
+        monkeypatch.setenv("MOSAIC_RETRY_BASE_S", "0.01")
+        with make_engine(index, grid) as eng:
+            eng.warmup()
+            with telemetry.capture() as events, faults.transient_errors(
+                1, sites=("serve.dispatch",)
+            ):
+                eng.join(
+                    rand_points(np.random.default_rng(35), 40),
+                    deadline_s=30.0,
+                )
+        root = next(
+            e for e in events
+            if e["event"] == "span" and e["name"] == "serve.request"
+        )
+        retry = next(e for e in events if e["event"] == "transient_retry")
+        assert retry["trace_id"] == root["trace_id"]
+        # and the shed path stamps too: root span closed exactly once
+        dispatch = next(
+            e for e in events
+            if e["event"] == "span" and e["name"] == "serve.dispatch"
+        )
+        assert dispatch["trace_id"] == root["trace_id"]
+
+    def test_shed_request_trace_records_the_reason(self, index, grid):
+        with make_engine(index, grid, max_wait_s=0.05) as eng:
+            eng.warmup()
+            with telemetry.capture() as events:
+                f = eng.submit(
+                    rand_points(np.random.default_rng(37), 10),
+                    deadline_s=0.0,
+                )
+                with pytest.raises(Overloaded):
+                    f.result(timeout=30)
+        root = next(
+            e for e in events
+            if e["event"] == "span" and e["name"] == "serve.request"
+        )
+        assert root["error"] == "Overloaded"
+        assert root["reason"] == "deadline"
+        shed = next(e for e in events if e["event"] == "serve_shed")
+        assert shed["trace_id"] == root["trace_id"]
 
 
 class TestSummarize:

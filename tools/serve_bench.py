@@ -63,6 +63,12 @@ def main() -> None:
     ap.add_argument("--poison", type=int, default=0,
                     help="inject N NaN rows into one request "
                     "(quarantine demo lane)")
+    ap.add_argument("--trail", default=None,
+                    help="export the captured telemetry trail "
+                    "(spans included) as JSONL")
+    ap.add_argument("--chrome-trace", default=None,
+                    help="export the trail as Chrome trace-event JSON "
+                    "(Perfetto-loadable)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -236,6 +242,21 @@ def main() -> None:
             join_cache=join_cache_stats(emit=False),
         )
         engine.close()
+        if args.trail or args.chrome_trace:
+            from mosaic_tpu import obs
+
+            if args.trail:
+                obs.write_jsonl(events, args.trail)
+            if args.chrome_trace:
+                obs.write_chrome_trace(events, args.chrome_trace)
+            traces = obs.trace_summary(events)
+            detail["traces"] = {
+                "count": len(traces),
+                "connected": sum(
+                    1 for t in traces.values()
+                    if t["roots"] == 1 and not t["orphans"]
+                ),
+            }
     except Exception as e:  # the artifact line must still parse
         detail["error"] = repr(e)[:400]
         try:

@@ -80,6 +80,12 @@ def main() -> None:
     ap.add_argument("--poison", type=int, default=0,
                     help="inject N NaN rows into the staged batches "
                     "before admission (quarantine demo lane)")
+    ap.add_argument("--trail", default=None,
+                    help="export the captured telemetry trail "
+                    "(spans included) as JSONL")
+    ap.add_argument("--chrome-trace", default=None,
+                    help="export the trail as Chrome trace-event JSON "
+                    "(Perfetto-loadable)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -97,6 +103,7 @@ def main() -> None:
         "detail": detail,
     }
     stages: list[dict] = []
+    root_span = None
     try:
         if os.environ.get("MOSAIC_BENCH_PLATFORM") == "cpu":
             import jax
@@ -118,8 +125,15 @@ def main() -> None:
             ring_from_generator,
         )
 
+        from mosaic_tpu import obs
+
         cap_events = telemetry.capture()
         stages = cap_events.__enter__()
+        # one root span: ring build, compiles, the measured loops, and
+        # the durable lane are ONE trace in the exported trail
+        root_span = obs.start_span(
+            "stream_bench", mode="device-gen" if args.device_gen else "host",
+        )
 
         h3 = H3IndexSystem()
         zones, zones_src = _load_zones()
@@ -376,6 +390,7 @@ def main() -> None:
             peak, src = hbm_peak(dev)
             detail["peak_hbm_bytes"] = peak
             detail["hbm_source"] = src
+        root_span.end()
         cap_events.__exit__(None, None, None)
     except Exception as e:  # the artifact line must still parse
         detail["error"] = repr(e)[:400]
@@ -386,6 +401,26 @@ def main() -> None:
         except Exception:
             detail.setdefault("device", "unknown")
 
+    if args.trail or args.chrome_trace:
+        try:
+            from mosaic_tpu import obs as _obs
+
+            if root_span is not None:
+                root_span.end()  # idempotent; closes on the error path
+            if args.trail:
+                _obs.write_jsonl(stages, args.trail)
+            if args.chrome_trace:
+                _obs.write_chrome_trace(stages, args.chrome_trace)
+            traces = _obs.trace_summary(stages)
+            detail["traces"] = {
+                "count": len(traces),
+                "connected": sum(
+                    1 for t in traces.values()
+                    if t["roots"] == 1 and not t["orphans"]
+                ),
+            }
+        except Exception as e:
+            detail["trail_error"] = repr(e)[:200]
     detail["stages"] = [
         s for s in stages if s.get("event") == "stream_stage"
     ]
