@@ -287,7 +287,34 @@ def _maybe_late_tpu_retry(obj: dict) -> dict:
     return obj
 
 
-_CACHE_VERSION = 6  # bump when ChipIndex/HostRecheck layout changes
+#: nominal HBM bandwidth per chip, GB/s, keyed by device_kind substring
+#: (checked in order — "v5p" before "v5" matters)
+_HBM_PEAK_GBPS = (
+    ("v6e", 1640.0),
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+)
+
+
+def _hbm_peak_gbps():
+    """Peak HBM GB/s of device 0, or None off-TPU / unknown kind — the
+    roofline then reports achieved GB/s without a %-of-peak figure."""
+    import jax
+
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return None
+    for pat, peak in _HBM_PEAK_GBPS:
+        if pat in kind:
+            return peak
+    return None
+
+
+_CACHE_VERSION = 7  # bump when ChipIndex/HostRecheck layout changes
 
 
 def _load_or_build_index(zones, zones_src: str, h3):
@@ -699,24 +726,63 @@ def main():
                 except Exception as e:
                     detail["writeback"][f"{name}_error"] = repr(e)[:200]
             detail["main_points_per_sec"] = round(dev_rate, 1)
-        # probe traffic: found points pay the tier-1 flat edge gather
-        # (20 B/edge), heavy-cell points additionally the tier-2 row — the
-        # HBM roofline of the join (misses stop at the 96 B hash bucket)
+        # probe traffic roofline, computed from the arrays one probe
+        # actually touches (never hand-written): a miss stops at one hash
+        # bucket row, a found point adds its cell's tier-1 edge row,
+        # heavy-cell points additionally the tier-2 row. Emitted per
+        # writeback variant so a lane-plumbing change shows up as a
+        # bandwidth delta, not just a pts/s delta.
+        bucket_b = int(index.table_cell.shape[1]) * (
+            index.table_cell.dtype.itemsize + index.table_slot.dtype.itemsize
+        )
+        edge_b = (
+            int(index.cell_edges.shape[-1]) * index.cell_edges.dtype.itemsize
+            + index.cell_ebits.dtype.itemsize
+        )
         e1 = int(index.cell_edges.shape[1])
         e2 = int(index.heavy_edges.shape[1]) if index.num_heavy_cells else 0
+        e3 = (
+            int(index.convex_edges.shape[2])
+            if index.num_convex_cells
+            else 0
+        )
         hfrac = float((np.asarray(index.cell_heavy) >= 0).mean())
-        bpp = 96 + 20.0 * (e1 + e2 * hfrac) * ffrac
+        bpp = bucket_b + edge_b * (e1 + e2 * hfrac) * ffrac
+        peak = _hbm_peak_gbps()
+        roofline = {
+            "bytes_per_point": round(bpp, 1),
+            "bucket_bytes": bucket_b,
+            "edge_bytes": edge_b,
+            "hbm_peak_gbps": peak,
+            "heavy_cell_frac": round(hfrac, 4),
+            # what the adaptive router's lanes each cost per routed point
+            # (light = tier-1 row, heavy adds the tier-2 row, convex reads
+            # the y-bucketed reduced row instead of the tier-1 row)
+            "per_lane_bytes_per_point": {
+                "light": bucket_b + edge_b * e1,
+                "heavy": bucket_b + edge_b * (e1 + e2),
+                "convex": bucket_b + edge_b * e3,
+            },
+            "per_writeback": {},
+        }
+        for vname, vrate in detail["writeback"].items():
+            if not isinstance(vrate, (int, float)):
+                continue  # "winner" tag, pass-time lists, error strings
+            v_gbps = bpp * vrate / 1e9
+            entry = {
+                "points_per_sec": vrate,
+                "achieved_gbps": round(v_gbps, 2),
+            }
+            if peak:
+                entry["pct_hbm_peak"] = round(100.0 * v_gbps / peak, 2)
+            roofline["per_writeback"][vname] = entry
         detail.update(
             n_points=n_device,
             device_s=round(dev_s, 3),
             match_rate=round(n_match / n_device, 4),
             found_rate=round(ffrac, 4),
             overflow=n_over,
-            roofline=(
-                f"~{bpp:.0f} B/pt probe traffic -> "
-                f"{bpp * dev_rate / 1e9:.0f} GB/s achieved vs ~800 GB/s "
-                f"v5e HBM; heavy cells {hfrac:.1%} of {index.num_cells}"
-            ),
+            roofline=roofline,
         )
 
         # Pallas zone-level kernel lane (the BASELINE.json north-star

@@ -42,11 +42,26 @@ from ..core.geometry.device import DeviceGeometry
 _BIG_F = 1e30
 _I0 = np.int32(0)  # index-map literal: a python 0 traces as i64 under x64
 _SENT = 2**30  # python int: jnp scalars would be captured as kernel consts
+_I32_MAX = int(np.iinfo(np.int32).max)
+
+
+class TilingError(ValueError):
+    """A pad/tile size violates the TPU (8, 128) f32 tiling contract.
+
+    Raised at call time, where the bad argument is visible — the
+    alternative is a shape miscompile deep inside ``pallas_call`` whose
+    message names neither the argument nor the caller.
+    """
 
 
 def _pad_to(x: np.ndarray | jax.Array, size: int, axis: int, value=0):
     pad = size - x.shape[axis]
-    if pad <= 0:
+    if pad < 0:
+        raise TilingError(
+            f"_pad_to cannot shrink axis {axis}: size {size} < existing "
+            f"{x.shape[axis]}"
+        )
+    if pad == 0:
         return x
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
@@ -58,9 +73,22 @@ def edge_planes(polys: DeviceGeometry, g_pad: int = 128, e_pad: int = 64):
 
     Returns (planes, g_real) where planes[0..3] = ax, ay, bx, by and invalid
     edges are encoded as degenerate (ay == by == BIG) so they never straddle
-    any point's scanline. ``e_pad`` should be a multiple of pip_zone's
-    ``tile_e`` and ``g_pad`` a multiple of its ``tile_g`` (defaults align).
+    any point's scanline. ``e_pad`` must be a multiple of 8 (sublane axis)
+    and ``g_pad`` a multiple of 128 (lane axis) — the (8, 128) f32 tile
+    contract; violations raise :class:`TilingError` here instead of
+    miscompiling inside ``pallas_call``. Align them with pip_zone's
+    ``tile_e``/``tile_g`` (defaults do).
     """
+    if g_pad < 128 or g_pad % 128:
+        raise TilingError(
+            f"g_pad must be a positive multiple of 128 (TPU lane width), "
+            f"got {g_pad}"
+        )
+    if e_pad < 8 or e_pad % 8:
+        raise TilingError(
+            f"e_pad must be a positive multiple of 8 (TPU sublane width), "
+            f"got {e_pad}"
+        )
     # host-side edge extraction through the shared contract
     # (core.geometry.device.edges with xp=np): one verts-sized
     # device-to-host copy, then pure numpy — no device dispatch during an
@@ -242,6 +270,238 @@ def pip_zone(
         )(px, py, planes)
     out = out.reshape(-1)[:N]
     return jnp.where(out >= _SENT, -1, out)
+
+
+def _pip_heavy_kernel(*refs, tile_e, tile_g, m2, banded):
+    """Grid = (point_blocks, heavy_row_blocks, edge_blocks); edges innermost.
+
+    Parity is XOR-accumulated per (point, heavy-row) pair with the same
+    multiply-then-divide crossing formula as ``sql.join._ray_parity`` so the
+    lane is bit-identical to the gather engine it replaces. Zero-padded
+    edges are inert: a (0,0)->(0,0) segment never straddles a scanline and
+    carries bits == 0, so it contributes to neither parity nor the band.
+    """
+    if banded:
+        (px_ref, py_ref, row_ref, planes_ref, bits_ref, geom_ref, eps_ref,
+         out_ref, near_ref, par, nearacc) = refs
+    else:
+        (px_ref, py_ref, row_ref, planes_ref, bits_ref, geom_ref,
+         out_ref, par) = refs
+        eps_ref = near_ref = nearacc = None
+    g_blk = pl.program_id(1)
+    e_blk = pl.program_id(2)
+    n_e = pl.num_programs(2)
+
+    @pl.when(jnp.logical_and(g_blk == 0, e_blk == 0))
+    def _():
+        out_ref[:] = jnp.full_like(out_ref, jnp.int32(_I32_MAX))
+        if banded:
+            near_ref[:] = jnp.zeros_like(near_ref)
+
+    @pl.when(e_blk == 0)
+    def _():
+        par[:] = jnp.zeros_like(par)
+        if banded:
+            nearacc[:] = jnp.zeros_like(nearacc)
+
+    px = px_ref[:]  # (tile_n, 1)
+    py = py_ref[:]
+
+    def edge_step(t, carry):
+        p = carry[0]
+        ax = planes_ref[0, t, :][None, :]  # (1, tile_g)
+        ay = planes_ref[1, t, :][None, :]
+        bx = planes_ref[2, t, :][None, :]
+        by = planes_ref[3, t, :][None, :]
+        bits = bits_ref[t, :][None, :]
+        straddle = (ay > py) != (by > py)  # (tile_n, tile_g)
+        denom = jnp.where(by == ay, jnp.ones_like(by), by - ay)
+        # multiply-then-divide, the exact evaluation order of
+        # _ray_parity — NOT pip_zone's precomputed slope, whose rounding
+        # differs and would break the bit-identity contract
+        xcross = ax + (py - ay) * (bx - ax) / denom
+        crossed = straddle & (px < xcross)
+        p = p ^ jnp.where(crossed, bits, jnp.zeros_like(bits))
+        if not banded:
+            return (p,)
+        eps2v = eps_ref[0, 0]
+        ex = bx - ax
+        ey = by - ay
+        qx = px - ax
+        qy = py - ay
+        dd = ex * ex + ey * ey
+        tt = (qx * ex + qy * ey) / jnp.where(
+            dd == jnp.zeros_like(dd), jnp.ones_like(dd), dd
+        )
+        # clip(x, 0, 1) spelled as min/max of *_like tensors: a python
+        # float literal lowers as f64 under x64 and Mosaic cannot cast it
+        tt = jnp.minimum(
+            jnp.maximum(tt, jnp.zeros_like(tt)), jnp.ones_like(tt)
+        )
+        rx = qx - tt * ex
+        ry = qy - tt * ey
+        hit = (rx * rx + ry * ry <= eps2v) & (bits != jnp.zeros_like(bits))
+        return (p, carry[1] | hit.astype(jnp.int32))
+
+    if banded:
+        pres = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(tile_e), edge_step,
+            (par[:], nearacc[:]),
+        )
+        par[:] = pres[0]
+        nearacc[:] = pres[1]
+    else:
+        par[:] = jax.lax.fori_loop(
+            jnp.int32(0), jnp.int32(tile_e),
+            lambda t, p: edge_step(t, (p,))[0], par[:],
+        )
+
+    @pl.when(e_blk == n_e - 1)
+    def _():
+        lane = (
+            jax.lax.broadcasted_iota(jnp.int32, par.shape, 1)
+            + g_blk * tile_g
+        )
+        belongs = lane == row_ref[:]  # each point owns exactly one row
+        p = par[:]
+        best = jnp.full_like(p, jnp.int32(_I32_MAX))
+        for m in range(m2):  # static: slot count is a python int
+            gm = geom_ref[m, :][None, :]
+            inm = ((p >> m) & 1) == 1
+            best = jnp.minimum(
+                best,
+                jnp.where(inm & (gm >= 0), gm, jnp.int32(_I32_MAX)),
+            )
+        best = jnp.where(belongs, best, jnp.int32(_I32_MAX))
+        out_ref[:] = jnp.minimum(
+            out_ref[:], jnp.min(best, axis=1, keepdims=True)
+        )
+        if banded:
+            nb = jnp.where(belongs, nearacc[:], jnp.zeros_like(nearacc))
+            near_ref[:] = jnp.maximum(
+                near_ref[:], jnp.max(nb, axis=1, keepdims=True)
+            )
+
+
+def pip_heavy_tiled(
+    px: jax.Array,
+    py: jax.Array,
+    rows: jax.Array,
+    heavy_edges: jax.Array,
+    heavy_ebits: jax.Array,
+    heavy_slot_geom: jax.Array,
+    eps2: jax.Array | float | None = None,
+    *,
+    tile_n: int = 512,
+    tile_e: int = 64,
+    tile_g: int = 128,
+    interpret: bool = False,
+):
+    """Tiled heavy-cell probe: per-point slot parity against VMEM tables.
+
+    ``px``/``py``: (K,) f32 compacted heavy-lane points; ``rows``: (K,)
+    int32 heavy-table row per point (pad with -1). ``heavy_edges`` (H, E2,
+    4) f32, ``heavy_ebits`` (H, E2) uint32 and ``heavy_slot_geom`` (H, M2)
+    int32 are the ChipIndex heavy tables, transposed here to lane-major
+    planes — heavy rows ride the lane axis, edges the sublane axis, points
+    the grid — and zero-padded (zero edges are inert, pad lanes carry
+    geom -1 and belong to no point). Returns ``(best, near)`` with
+    ``best`` (K,) int32 using int32-max as the no-hit sentinel (the same
+    sentinel as sql.join) and ``near`` (K,) bool when ``eps2`` is given,
+    else None.
+    """
+    if heavy_edges.dtype != jnp.float32:
+        raise ValueError(
+            "pip_heavy_tiled requires float32 heavy tables (Mosaic has no "
+            f"f64 path), got {heavy_edges.dtype}"
+        )
+    if tile_g < 128 or tile_g % 128:
+        raise TilingError(
+            f"tile_g must be a positive multiple of 128, got {tile_g}"
+        )
+    if tile_e % 8 or tile_n % 8:
+        raise TilingError(
+            f"tile_e/tile_n must be multiples of 8, got {tile_e}/{tile_n}"
+        )
+    K = px.shape[0]
+    H, E2 = heavy_ebits.shape
+    M2 = heavy_slot_geom.shape[1]
+    tile_e = min(tile_e, ((E2 + 7) // 8) * 8)
+    tile_n = min(tile_n, ((K + 7) // 8) * 8)
+    n_pad = ((K + tile_n - 1) // tile_n) * tile_n
+    e_sz = ((E2 + tile_e - 1) // tile_e) * tile_e
+    g_sz = ((H + tile_g - 1) // tile_g) * tile_g
+    m2_pad = ((M2 + 7) // 8) * 8
+
+    pxp = _pad_to(px.reshape(-1), n_pad, 0, _BIG_F).reshape(-1, 1)
+    pyp = _pad_to(py.reshape(-1), n_pad, 0, _BIG_F).reshape(-1, 1)
+    rowp = _pad_to(
+        rows.reshape(-1).astype(jnp.int32), n_pad, 0, -1
+    ).reshape(-1, 1)
+    planes = jnp.transpose(heavy_edges, (2, 1, 0))  # (4, E2, H)
+    planes = _pad_to(_pad_to(planes, e_sz, 1, 0.0), g_sz, 2, 0.0)
+    bits = jax.lax.bitcast_convert_type(heavy_ebits, jnp.int32).T  # (E2, H)
+    bits = _pad_to(_pad_to(bits, e_sz, 0, 0), g_sz, 1, 0)
+    geom = _pad_to(
+        _pad_to(heavy_slot_geom.astype(jnp.int32).T, m2_pad, 0, -1),
+        g_sz, 1, -1,
+    )
+
+    banded = eps2 is not None
+    pt_spec = lambda: pl.BlockSpec(
+        (tile_n, 1), lambda i, g, e: (i, _I0), memory_space=pltpu.VMEM
+    )
+    in_specs = [
+        pt_spec(),
+        pt_spec(),
+        pt_spec(),
+        pl.BlockSpec(
+            (4, tile_e, tile_g), lambda i, g, e: (_I0, e, g),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (tile_e, tile_g), lambda i, g, e: (e, g),
+            memory_space=pltpu.VMEM,
+        ),
+        pl.BlockSpec(
+            (m2_pad, tile_g), lambda i, g, e: (_I0, g),
+            memory_space=pltpu.VMEM,
+        ),
+    ]
+    args = [pxp, pyp, rowp, planes, bits, geom]
+    out_shape = [jax.ShapeDtypeStruct((n_pad, 1), jnp.int32)]
+    out_specs = [pt_spec()]
+    scratch = [pltpu.VMEM((tile_n, tile_g), jnp.int32)]
+    if banded:
+        in_specs.append(
+            pl.BlockSpec(
+                (1, 1), lambda i, g, e: (_I0, _I0),
+                memory_space=pltpu.SMEM,
+            )
+        )
+        args.append(jnp.asarray(eps2, jnp.float32).reshape(1, 1))
+        out_shape.append(jax.ShapeDtypeStruct((n_pad, 1), jnp.int32))
+        out_specs.append(pt_spec())
+        scratch.append(pltpu.VMEM((tile_n, tile_g), jnp.int32))
+
+    kernel = functools.partial(
+        _pip_heavy_kernel, tile_e=tile_e, tile_g=tile_g, m2=int(M2),
+        banded=banded,
+    )
+    with jax.named_scope("pip_heavy.pallas"):
+        res = pl.pallas_call(
+            kernel,
+            grid=(n_pad // tile_n, g_sz // tile_g, e_sz // tile_e),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(*args)
+    best = res[0].reshape(-1)[:K]
+    if banded:
+        return best, res[1].reshape(-1)[:K] != 0
+    return best, None
 
 
 def pip_zone_reference(points: jax.Array, polys: DeviceGeometry) -> jax.Array:

@@ -58,7 +58,23 @@ def read_trail(path: str) -> list[dict]:
                 rows.append(json.loads(line))
     if len(rows) == 1 and "detail" in rows[0]:
         det = rows[0]["detail"] or {}
-        return list(det.get("trail") or det.get("stages") or [])
+        stages = det.get("trail") or det.get("stages") or []
+        if isinstance(stages, dict):
+            # summary-only artifact ({stage_key: {total_s, count, ...}},
+            # the perf_gate golden shape): synthesize one pseudo-event
+            # per stage so breakdowns/diffs keep a real base instead of
+            # iterating the dict's key strings.
+            return [
+                {
+                    "event": "stage_summary",
+                    "stage_key": k,
+                    "seconds": float(v.get("total_s", 0.0)),
+                    "count": int(v.get("count", 1)),
+                }
+                for k, v in stages.items()
+                if isinstance(v, dict)
+            ]
+        return list(stages)
     return rows
 
 

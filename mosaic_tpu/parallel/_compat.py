@@ -13,9 +13,21 @@ from __future__ import annotations
 import jax
 
 
-def shard_map(f, *, mesh, in_specs, out_specs):
-    """`jax.shard_map` where available, else the experimental spelling."""
+def shard_map(f, *, mesh, in_specs, out_specs, check_rep=True):
+    """`jax.shard_map` where available, else the experimental spelling.
+
+    ``check_rep=False`` is required whenever the mapped body contains a
+    `pallas_call` (jax has no replication rule for it); the kwarg was
+    renamed ``check_vma`` in newer jax, so resolve whichever spelling
+    this install accepts.
+    """
     sm = getattr(jax, "shard_map", None)
     if sm is None:
         from jax.experimental.shard_map import shard_map as sm
-    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check_rep:
+        return sm(f, **kw)
+    try:
+        return sm(f, check_rep=False, **kw)
+    except TypeError:
+        return sm(f, check_vma=False, **kw)

@@ -40,6 +40,7 @@ from ..sql.join import (
     HostRecheck,
     host_join_with_cells,
     pip_join_points,
+    resolve_probe_mode,
 )
 from ..utils import get_logger
 
@@ -139,6 +140,13 @@ def pad_index_for_shards(index: ChipIndex, shards: int) -> ChipIndex:
         heavy_edges=index.heavy_edges,
         heavy_ebits=index.heavy_ebits,
         heavy_slot_geom=index.heavy_slot_geom,
+        # the per-cell route column shards with U; the tiny convex tables
+        # stay replicated like the heavy ones
+        cell_convex=pad0(index.cell_convex, du, -1),
+        convex_edges=index.convex_edges,
+        convex_ebits=index.convex_ebits,
+        convex_geom=index.convex_geom,
+        convex_ybin=index.convex_ybin,
     )
 
 
@@ -174,6 +182,11 @@ def _index_specs(spec, table_spec) -> ChipIndex:
         heavy_edges=P(),
         heavy_ebits=P(),
         heavy_slot_geom=P(),
+        cell_convex=spec,
+        convex_edges=P(),
+        convex_ebits=P(),
+        convex_geom=P(),
+        convex_ybin=P(),
     )
 
 
@@ -204,6 +217,7 @@ def _gather_index(idx: ChipIndex, axis_name: str, table_sharded: bool) -> ChipIn
         cell_slot_geom=g(idx.cell_slot_geom),
         cell_slot_core=g(idx.cell_slot_core),
         cell_heavy=g(idx.cell_heavy),
+        cell_convex=g(idx.cell_convex),
     )
 
 
@@ -213,6 +227,8 @@ def distributed_join_step(
     table_size: int | None = None,
     found_cap: int | None = None,
     heavy_cap: int | None = None,
+    probe: str = "scatter",
+    convex_cap: int | None = None,
 ):
     """Build the jitted full distributed join+aggregate step for ``mesh``.
 
@@ -230,8 +246,12 @@ def distributed_join_step(
       divides it; pass None to force replication);
     - ``match``   (N,) int32 matched polygon row (-1 none), sharded as input;
     - ``zone_counts`` (num_zones,) int64, globally psum-reduced (replicated);
-    - ``found_cap``/``heavy_cap``  optional PER-SHARD compaction caps
-      forwarded to `pip_join_points` (defaults are exact — no overflow).
+    - ``found_cap``/``heavy_cap``/``convex_cap``  optional PER-SHARD
+      compaction caps forwarded to `pip_join_points` (defaults are exact
+      — no overflow);
+    - ``probe``  the per-cell routing mode (see `pip_join_points`) —
+      resolve it with `resolve_probe_mode` BEFORE calling if the
+      force-lane env knob should apply (`dist_pip_join` does).
     """
     cell_shards = int(mesh.shape["cell"])
     table_sharded = (
@@ -245,7 +265,8 @@ def distributed_join_step(
     def step(points, pcells, index):
         full = _gather_index(index, "cell", table_sharded=table_sharded)
         match = pip_join_points(
-            points, pcells, full, heavy_cap=heavy_cap, found_cap=found_cap
+            points, pcells, full, heavy_cap=heavy_cap, found_cap=found_cap,
+            probe=probe, convex_cap=convex_cap,
         )
         zone = jnp.where(match >= 0, match, num_zones).astype(jnp.int32)
         counts = jax.ops.segment_sum(
@@ -259,6 +280,8 @@ def distributed_join_step(
         mesh=mesh,
         in_specs=(point_spec, point_spec, index_spec),
         out_specs=(point_spec, P()),
+        # the heavy lane's pallas_call has no shard_map replication rule
+        check_rep=probe in ("scatter", "adaptive-light", "adaptive-convex"),
     )
     return jax.jit(sharded)
 
@@ -276,12 +299,17 @@ def pad_points(points: np.ndarray, cells: np.ndarray, multiple: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _cached_step(mesh, num_zones, table_size, found_cap, heavy_cap):
-    """One compiled step per (mesh, zones, layout, caps) — escalation
-    re-enters here with grown caps, so only distinct cap sets compile."""
+def _cached_step(
+    mesh, num_zones, table_size, found_cap, heavy_cap,
+    probe="scatter", convex_cap=None,
+):
+    """One compiled step per (mesh, zones, layout, caps, probe) —
+    escalation re-enters here with grown caps, so only distinct cap sets
+    compile."""
     return distributed_join_step(
         mesh, num_zones, table_size=table_size,
         found_cap=found_cap, heavy_cap=heavy_cap,
+        probe=probe, convex_cap=convex_cap,
     )
 
 
@@ -295,6 +323,8 @@ def dist_pip_join(
     table_size: int | None = None,
     found_cap: int | None = None,
     heavy_cap: int | None = None,
+    probe: str = "scatter",
+    convex_cap: int | None = None,
     host: HostRecheck | None = None,
 ):
     """Managed distributed join: the resilience-wrapped spelling of
@@ -316,6 +346,7 @@ def dist_pip_join(
     Returns ``(match, zone_counts)``: (N,) int32 matched row per point
     and the (num_zones,) int64 per-zone histogram.
     """
+    probe = resolve_probe_mode(probe)
     host = host if host is not None else getattr(index, "host", None)
     raw = np.asarray(points, dtype=np.float64)
     pc = np.asarray(pcells)
@@ -329,8 +360,14 @@ def dist_pip_join(
     padded_index = pad_index_for_shards(index, int(mesh.shape["cell"]))
     p, c = pad_points((raw - shift).astype(dtype), pc, mesh.size)
     per_shard = p.shape[0] // mesh.size
+    if convex_cap is None and probe != "scatter" and index.num_convex_cells:
+        convex_cap = per_shard
     caps = _faults.clamp_caps(
-        {"found_cap": found_cap, "heavy_cap": heavy_cap}
+        {
+            "found_cap": found_cap,
+            "heavy_cap": heavy_cap,
+            "convex_cap": convex_cap if probe != "scatter" else None,
+        }
     )
     grow = {k: v for k, v in caps.items() if v is not None}
     ceilings = {k: per_shard for k in grow}
@@ -341,6 +378,7 @@ def dist_pip_join(
         step = _cached_step(
             mesh, num_zones, table_size,
             capset.get("found_cap"), capset.get("heavy_cap"),
+            probe, capset.get("convex_cap"),
         )
         match, counts = step(pj, cj, padded_index)
         return np.asarray(match)[:n], np.asarray(counts)

@@ -99,13 +99,17 @@ class BucketLadder:
 def dispatch_signature(
     bucket: int, index, *, writeback: str, lookup: str,
     found_cap: int | None, heavy_cap: int | None,
+    probe: str = "scatter", convex_cap: int | None = None,
 ) -> tuple:
     """The deterministic compile-cache key of one serve dispatch: the
     full static-argument set of the module-level jitted join plus the
     padded shape and index identity. Two dispatches with equal
     signatures replay the same executable; the engine asserts the
     signature set stops growing after :meth:`ServeEngine.warmup`."""
-    return (int(bucket), id(index), writeback, lookup, found_cap, heavy_cap)
+    return (
+        int(bucket), id(index), writeback, lookup, found_cap, heavy_cap,
+        probe, convex_cap,
+    )
 
 
 _METER = {"installed": False, "count": 0}

@@ -81,12 +81,20 @@ class ServeEngine:
         lookup: str | None = None,
         cell_dtype=None,
         watchdog_grace_s: float = 0.5,
+        probe: str = "scatter",
     ):
         self.index = index
         self.index_system = index_system
         self.resolution = index_system.resolution_arg(resolution)
         self.ladder = ladder or BucketLadder()
         self.writeback = writeback
+        # force-lane env resolution happens once, here — dispatch uses
+        # the pinned value so the compile-cache signature stays honest
+        self.probe = _join.resolve_probe_mode(probe)
+        if self.probe != "scatter" and writeback == "direct":
+            raise ValueError(
+                "probe='adaptive' requires writeback scatter|gather"
+            )
         self.cell_dtype = cell_dtype
         self.watchdog_grace_s = float(watchdog_grace_s)
         dtype = index.border.verts.dtype
@@ -245,16 +253,22 @@ class ServeEngine:
         static-arg set per bucket never changes at runtime."""
         fcap = None if self.writeback == "direct" else bucket
         hcap = bucket if self.index.num_heavy_cells else None
-        return fcap, hcap
+        ccap = (
+            bucket
+            if self.probe != "scatter" and self.index.num_convex_cells
+            else None
+        )
+        return fcap, hcap, ccap
 
     def _dispatch_device(self, padded: np.ndarray) -> np.ndarray:
         """One exact device join of a full-bucket batch (the compile
         unit warmup precompiles and dispatch replays)."""
         bucket = padded.shape[0]
-        fcap, hcap = self._caps(bucket)
+        fcap, hcap, ccap = self._caps(bucket)
         sig = dispatch_signature(
             bucket, self.index, writeback=self.writeback,
             lookup=self.lookup, found_cap=fcap, heavy_cap=hcap,
+            probe=self.probe, convex_cap=ccap,
         )
         if sig not in self._signatures:
             self._signatures.add(sig)
@@ -281,6 +295,7 @@ class ServeEngine:
                 shifted, cells, self.index,
                 heavy_cap=hcap, found_cap=fcap,
                 writeback=self.writeback, lookup=self.lookup,
+                probe=self.probe, convex_cap=ccap,
             )
         )
 

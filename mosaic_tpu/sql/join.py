@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 import time
 
 import jax
@@ -91,6 +92,13 @@ _DIRECT_CHUNK = 1 << 20
 CELL_MARGIN_K = 6.0
 EDGE_BAND_K = 16.0
 
+#: convex-lane table shape (adaptive router): y-scanline buckets per
+#: convex cell and the per-bucket edge capacity. A cell only qualifies
+#: when every pad-inflated bucket fits CONVEX_EDGE_CAP edges, so the lane
+#: reads at most EB edges/point against tier 1's full E1 row.
+CONVEX_BUCKETS = 8
+CONVEX_EDGE_CAP = 16
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
@@ -137,6 +145,24 @@ class ChipIndex:
     heavy_slot_geom: (H, M2) int32 — geom per heavy chip slot, -1 pad.
     H == 0 when no cell is heavy (tier 2 compiles away entirely).
 
+    Convex lane (adaptive router, ``probe="adaptive"``): single-chip
+    light cells whose border chip is one closed convex ring get a
+    reduced-edge test — edges are binned by y into ``KB`` scanline
+    buckets so a point touches only its bucket's ``EB`` edges instead of
+    the cell's full E1 row:
+
+    cell_convex: (U,) int32 — convex-table row of this cell, -1 otherwise.
+    convex_edges: (Cv, KB, EB, 4) — y-bucketed edges (the same f32 values
+                  as the cell's tier-1 row; zero pad is inert).
+    convex_ebits: (Cv, KB, EB) uint32 — 1 for real edges, 0 pad.
+    convex_geom:  (Cv,) int32 — the single chip's geom id.
+    convex_ybin:  (Cv, 3) f32 — [y_min, buckets/height, band_guard²];
+                  buckets overlap by a pad of 4·EDGE_BAND_K·eps·scale so
+                  bucket-boundary rounding can never drop a straddling
+                  edge, and the epsilon band stays exact while the
+                  runtime eps² <= band_guard² (the router checks).
+    Cv == 0 when no cell qualifies (the lane compiles away).
+
     Instances built by :func:`build_chip_index` additionally carry a
     ``host`` attribute (:class:`HostRecheck`, f64 host twin of the edge
     tables) — not a dataclass field, so it stays out of the pytree.
@@ -160,6 +186,11 @@ class ChipIndex:
     heavy_edges: jax.Array
     heavy_ebits: jax.Array
     heavy_slot_geom: jax.Array
+    cell_convex: jax.Array
+    convex_edges: jax.Array
+    convex_ebits: jax.Array
+    convex_geom: jax.Array
+    convex_ybin: jax.Array
 
     @property
     def num_cells(self) -> int:
@@ -172,6 +203,10 @@ class ChipIndex:
     @property
     def num_heavy_cells(self) -> int:
         return int(self.heavy_edges.shape[0])
+
+    @property
+    def num_convex_cells(self) -> int:
+        return int(self.convex_edges.shape[0])
 
 
 @dataclasses.dataclass
@@ -552,6 +587,15 @@ def build_chip_index(
         heavy_ebits = np.zeros((0, 8), dtype=np.uint32)
         hgeom = np.zeros((0, 1), dtype=np.int32)
 
+    coord_scale = (
+        float(np.abs(edges_all64).max()) if edges_all64.size else 1.0
+    )
+    (
+        cell_convex, convex_edges, convex_ebits, convex_geom, convex_ybin,
+    ) = _build_convex_tables(
+        U, epc, heavy_mask, cell_edges, slot_geom, slot_core, coord_scale
+    )
+
     idx = ChipIndex(
         cells=jnp.asarray(uniq, dtype=jnp.int64),
         chip_rows=jnp.asarray(rows),
@@ -571,6 +615,11 @@ def build_chip_index(
         heavy_edges=jnp.asarray(heavy_edges),
         heavy_ebits=jnp.asarray(heavy_ebits),
         heavy_slot_geom=jnp.asarray(hgeom),
+        cell_convex=jnp.asarray(cell_convex),
+        convex_edges=jnp.asarray(convex_edges),
+        convex_ebits=jnp.asarray(convex_ebits),
+        convex_geom=jnp.asarray(convex_geom),
+        convex_ybin=jnp.asarray(convex_ybin),
     )
     # host f64 companion for the epsilon-band recheck — a plain attribute,
     # deliberately OUTSIDE the pytree (jit must never device-put it);
@@ -587,9 +636,93 @@ def build_chip_index(
         heavy_ebits=heavy_ebits,
         heavy_slot_geom=hgeom,
         shift=shift64,
-        coord_scale=float(np.abs(edges_all64).max()) if edges_all64.size else 1.0,
+        coord_scale=coord_scale,
     )
     return idx
+
+
+def _build_convex_tables(
+    U, epc, heavy_mask, cell_edges, slot_geom, slot_core, coord_scale
+):
+    """Host: classify convex-eligible cells and y-bucket their edges.
+
+    A cell qualifies when it is light, holds exactly one non-core chip
+    whose edges form one closed convex ring, and every pad-inflated y
+    bucket fits CONVEX_EDGE_CAP edges. The bucketed edges are the SAME
+    f32 values as the cell's tier-1 row (bit-identity: the lane evaluates
+    the identical crossing arithmetic on a subset of edges that provably
+    contains every edge the point's scanline can straddle). Buckets are
+    inflated by ``pad = 4·EDGE_BAND_K·eps(f32)·coord_scale``: f32
+    bucket-index rounding moves a point across a boundary by at most a
+    few ulps (< pad), and the epsilon band reaches at most sqrt(eps²)
+    <= pad/2 beyond the straddle set while the runtime guard
+    ``eps² <= band_guard² = (pad/2)²`` holds.
+    """
+    KB = CONVEX_BUCKETS
+    pad = 4.0 * EDGE_BAND_K * float(np.finfo(np.float32).eps) * coord_scale
+    cell_convex = np.full(U, -1, dtype=np.int32)
+    picked = []  # (u, (KB, EB) edge-index lists, ymin, inv)
+    n_slots = (slot_geom >= 0).sum(axis=1)
+    cand = np.nonzero(
+        (~heavy_mask)
+        & (n_slots == 1)
+        & (slot_geom[:, 0] >= 0)
+        & (~slot_core[:, 0])
+        & (epc >= 3)
+    )[0]
+    for u in cand:
+        k = int(epc[u])
+        ef = cell_edges[u, :k].astype(np.float64)  # the probed f32 values
+        # one closed ring: each edge's b is the next edge's a (cyclic);
+        # multi-ring chips (holes) break the chain and fall out here
+        if not np.array_equal(ef[:, 2:4], np.roll(ef[:, 0:2], -1, axis=0)):
+            continue
+        d = ef[:, 2:4] - ef[:, 0:2]
+        cr = d[:, 0] * np.roll(d[:, 1], -1) - d[:, 1] * np.roll(d[:, 0], -1)
+        if not (np.all(cr >= 0) or np.all(cr <= 0)):
+            continue
+        ys = np.concatenate([ef[:, 1], ef[:, 3]])
+        ymin, ymax = float(ys.min()), float(ys.max())
+        height = ymax - ymin
+        if not height > 4.0 * pad:  # degenerate: buckets would alias
+            continue
+        hb = height / KB
+        elo = np.minimum(ef[:, 1], ef[:, 3])
+        ehi = np.maximum(ef[:, 1], ef[:, 3])
+        buckets = []
+        for b in range(KB):
+            blo = ymin + b * hb - pad
+            bhi = ymin + (b + 1) * hb + pad
+            sel = np.nonzero((ehi >= blo) & (elo <= bhi))[0]
+            if sel.size > CONVEX_EDGE_CAP:
+                buckets = None
+                break
+            buckets.append(sel)
+        if buckets is None:
+            continue
+        picked.append((u, buckets, np.float32(ymin), np.float32(KB / height)))
+    Cv = len(picked)
+    if not Cv:
+        return (
+            cell_convex,
+            np.zeros((0, KB, 8, 4), dtype=cell_edges.dtype),
+            np.zeros((0, KB, 8), dtype=np.uint32),
+            np.zeros((0,), dtype=np.int32),
+            np.zeros((0, 3), dtype=np.float32),
+        )
+    EB = _round8(max(max(s.size for s in bk) for _, bk, _, _ in picked))
+    convex_edges = np.zeros((Cv, KB, EB, 4), dtype=cell_edges.dtype)
+    convex_ebits = np.zeros((Cv, KB, EB), dtype=np.uint32)
+    convex_geom = np.zeros(Cv, dtype=np.int32)
+    convex_ybin = np.zeros((Cv, 3), dtype=np.float32)
+    for row, (u, buckets, ymin, inv) in enumerate(picked):
+        cell_convex[u] = row
+        convex_geom[row] = slot_geom[u, 0]
+        convex_ybin[row] = (ymin, inv, np.float32((pad / 2.0) ** 2))
+        for b, sel in enumerate(buckets):
+            convex_edges[row, b, : sel.size] = cell_edges[u, sel]
+            convex_ebits[row, b, : sel.size] = 1
+    return cell_convex, convex_edges, convex_ebits, convex_geom, convex_ybin
 
 
 def _probe_slot(pcells: jax.Array, index: ChipIndex) -> jax.Array:
@@ -618,20 +751,23 @@ def _probe_slot(pcells: jax.Array, index: ChipIndex) -> jax.Array:
 
 
 def _probe_counts(pcells: jax.Array, index: ChipIndex):
-    """Device-side exact compaction-cap inputs: one (2,) array of (found
-    count, heavy-cell count) — `pip_join` pulls these two ints in a single
-    transfer instead of the whole cell column (32 MB at 4M points over a
-    ~10 MB/s tunnel)."""
+    """Device-side exact compaction-cap inputs: one (3,) array of (found
+    count, heavy-cell count, convex-cell count) — `pip_join` pulls these
+    ints in a single transfer instead of the whole cell column (32 MB at
+    4M points over a ~10 MB/s tunnel)."""
     u = _probe_slot(pcells, index)
     found = u >= 0
     nf = found.sum()
+    us = jnp.maximum(u, 0)
     if index.heavy_edges.shape[0]:
-        nh = (
-            jnp.where(found, index.cell_heavy[jnp.maximum(u, 0)], -1) >= 0
-        ).sum()
+        nh = (jnp.where(found, index.cell_heavy[us], -1) >= 0).sum()
     else:
         nh = jnp.zeros((), nf.dtype)
-    return jnp.stack([nf, nh])
+    if index.convex_edges.shape[0]:
+        nc = (jnp.where(found, index.cell_convex[us], -1) >= 0).sum()
+    else:
+        nc = jnp.zeros((), nf.dtype)
+    return jnp.stack([nf, nh, nc])
 
 
 _JIT_COUNTS = jax.jit(_probe_counts)
@@ -970,22 +1106,29 @@ def _heavy_rows_mxu(h2: jax.Array, index: "ChipIndex"):
 def _heavy_tier(
     px, py, hs, index, heavy_cap, k2_default, out_len, eps2,
     lookup="gather", compaction="scatter", compact_block=256,
+    engine="gather",
 ):
     """Tier 2, shared by every probe plumbing mode: compact the rows whose
     cell is heavy, probe the wide rows, scatter back to ``out_len``.
+
+    ``engine="pallas"`` runs the probe through the tiled
+    :func:`~mosaic_tpu.kernels.pip.pip_heavy_tiled` kernel (heavy tables
+    pinned in VMEM, bit-identical crossing arithmetic) instead of the
+    row-gather + `_ray_parity` pipeline; interpret mode is selected
+    automatically off-TPU so CPU tests exercise the same kernel.
 
     Returns (best2 (out_len,), over2 (out_len,) overflow mask,
     near2 (out_len,) | None when ``eps2`` is None)."""
     with jax.named_scope("pip.tier2"):
         return _heavy_tier_impl(
             px, py, hs, index, heavy_cap, k2_default, out_len, eps2,
-            lookup, compaction, compact_block,
+            lookup, compaction, compact_block, engine,
         )
 
 
 def _heavy_tier_impl(
     px, py, hs, index, heavy_cap, k2_default, out_len, eps2,
-    lookup, compaction, compact_block,
+    lookup, compaction, compact_block, engine,
 ):
     K2 = int(heavy_cap) if heavy_cap else k2_default
     K2 = max(8, min(K2, k2_default))
@@ -994,16 +1137,28 @@ def _heavy_tier_impl(
     else:
         src2, valid2, over2, _ = _compact(hs >= 0, K2)
     h2 = jnp.maximum(hs[src2], 0)
-    if lookup == "mxu":
-        hedges, hebits, hgeoms = _heavy_rows_mxu(h2, index)
-    else:
-        hedges, hebits = index.heavy_edges[h2], index.heavy_ebits[h2]
-        hgeoms = index.heavy_slot_geom[h2]
     # one (K2, 2) gather, not two serialized column gathers (see tier 1)
     pq2 = jnp.stack([px, py], axis=1)[src2]
-    r2 = _ray_parity(pq2[:, 0], pq2[:, 1], hedges, hebits, eps2=eps2)
-    par2, near2 = r2 if eps2 is not None else (r2, None)
-    best2k = _slot_best(par2, hgeoms)  # invalid slots never land (drop)
+    if engine == "pallas":
+        from ..kernels.pip import pip_heavy_tiled
+
+        rows2 = jnp.where(valid2, h2, -1)
+        best2k, near2 = pip_heavy_tiled(
+            pq2[:, 0], pq2[:, 1], rows2,
+            index.heavy_edges, index.heavy_ebits, index.heavy_slot_geom,
+            eps2=eps2, interpret=jax.default_backend() != "tpu",
+        )
+        if near2 is None and eps2 is not None:  # pragma: no cover
+            near2 = jnp.zeros(pq2.shape[0], bool)
+    else:
+        if lookup == "mxu":
+            hedges, hebits, hgeoms = _heavy_rows_mxu(h2, index)
+        else:
+            hedges, hebits = index.heavy_edges[h2], index.heavy_ebits[h2]
+            hgeoms = index.heavy_slot_geom[h2]
+        r2 = _ray_parity(pq2[:, 0], pq2[:, 1], hedges, hebits, eps2=eps2)
+        par2, near2 = r2 if eps2 is not None else (r2, None)
+        best2k = _slot_best(par2, hgeoms)  # invalid slots never land (drop)
     # unique no-combiner scatter back (see _compact): valid src2 row ids
     # are unique; invalid slots drop via distinct out-of-bounds dests
     dest2 = jnp.where(
@@ -1024,6 +1179,40 @@ def _heavy_tier_impl(
     return best2, over2, near_sc
 
 
+#: lanes a forced-adaptive probe can pin (MOSAIC_PROBE_FORCE_LANE)
+_PROBE_LANES = ("light", "heavy", "convex")
+
+
+def _probe_modes():
+    return ("scatter", "adaptive") + tuple(
+        f"adaptive-{ln}" for ln in _PROBE_LANES
+    )
+
+
+def resolve_probe_mode(probe: str) -> str:
+    """Normalize a ``probe`` argument, folding in the force-lane env knob.
+
+    ``MOSAIC_PROBE_FORCE_LANE=light|heavy|convex`` rewrites ``adaptive``
+    to the pinned variant ``adaptive-<lane>`` HERE — before the value
+    reaches any jit static argument — so the knob can never be baked
+    stale into a compiled program's cache entry.
+    """
+    if probe not in _probe_modes():
+        raise ValueError(
+            f"probe must be one of {_probe_modes()}, got {probe!r}"
+        )
+    if probe == "adaptive":
+        lane = os.environ.get("MOSAIC_PROBE_FORCE_LANE", "").strip().lower()
+        if lane:
+            if lane not in _PROBE_LANES:
+                raise ValueError(
+                    f"MOSAIC_PROBE_FORCE_LANE must be one of "
+                    f"{_PROBE_LANES}, got {lane!r}"
+                )
+            return f"adaptive-{lane}"
+    return probe
+
+
 def pip_join_points(
     points: jax.Array,
     pcells: jax.Array,
@@ -1035,6 +1224,8 @@ def pip_join_points(
     lookup: str = "gather",
     compaction: str = "scatter",
     compact_block: int = 256,
+    probe: str = "scatter",
+    convex_cap: int | None = None,
 ) -> jax.Array:
     """(N,) int32 — smallest matching polygon row per point, -1 if none.
 
@@ -1075,6 +1266,21 @@ def pip_join_points(
     but no prefix scan, no point permutation and no writeback, which cost
     ~65 ms combined at 4M on v5e while the full row-gather runs ~30 ms;
     ``found_cap`` is ignored and tier-1 overflow is impossible).
+
+    ``probe="adaptive"`` switches on per-cell density routing inside this
+    one jitted program: light cells keep the tier-1 path above, heavy
+    cells run tier 2 through the tiled Pallas kernel
+    (:func:`~mosaic_tpu.kernels.pip.pip_heavy_tiled`, interpret mode off
+    TPU), and convex single-chip cells divert to a y-bucketed
+    reduced-edge test sized by ``convex_cap`` (default exact: N).
+    Results are bit-identical to ``probe="scatter"`` — the kernel
+    reproduces `_ray_parity`'s evaluation order and the convex tables
+    hold the same f32 edge values as tier 1. ``adaptive-light`` /
+    ``adaptive-heavy`` / ``adaptive-convex`` pin one lane for isolation
+    (benchmarks, the CI probe-smoke gate); `resolve_probe_mode` folds
+    the ``MOSAIC_PROBE_FORCE_LANE`` env knob into these pinned values
+    before jit ever sees the argument. Convex-lane overflow returns
+    :data:`OVERFLOW`, exactly like the other caps.
     """
     if writeback not in ("scatter", "gather", "direct"):
         raise ValueError(
@@ -1091,6 +1297,13 @@ def pip_join_points(
             f"compact_block must be a multiple of 128 (TPU lane width), "
             f"got {compact_block}"
         )
+    probe = resolve_probe_mode(probe)
+    adaptive = probe != "scatter"
+    if adaptive and writeback == "direct":
+        raise ValueError(
+            "probe='adaptive' routes through compaction; it composes "
+            "with writeback scatter|gather, not direct"
+        )
     if lookup != "gather" and (
         writeback == "direct" or index.cell_edges.dtype != jnp.float32
     ):
@@ -1105,6 +1318,32 @@ def pip_join_points(
     found = u >= 0
     banded_d = edge_eps2 is not None
     H = int(index.heavy_edges.shape[0])
+    CV = int(index.convex_edges.shape[0])
+    # adaptive per-cell routing: the density class is a table lookup, so
+    # the route costs one extra (N,) gather. Convex cells leave the light
+    # lane; heavy POINTS stay in it (their tier-1 row holds the cell's
+    # core/light chips — the Pallas lane replaces only the tier-2 probe).
+    use_convex = adaptive and CV > 0 and probe in ("adaptive", "adaptive-convex")
+    heavy_engine = (
+        "pallas"
+        if adaptive
+        and probe in ("adaptive", "adaptive-heavy")
+        and index.heavy_edges.dtype == jnp.float32
+        else "gather"
+    )
+    if use_convex:
+        with jax.named_scope("pip.route"):
+            cvrow = jnp.where(
+                found, index.cell_convex[jnp.maximum(u, 0)], -1
+            )
+            conv = cvrow >= 0
+            if banded_d:
+                # band exactness holds only while eps² fits under the
+                # bucket pad guard; wider bands fall back to tier 1
+                guard2 = index.convex_ybin[jnp.maximum(cvrow, 0), 2]
+                conv = conv & (edge_eps2 <= guard2)
+    else:
+        conv = None
 
     if writeback == "direct":
         us = jnp.maximum(u, 0)
@@ -1165,15 +1404,16 @@ def pip_join_points(
             return out, near1 & found
         return out
 
+    light = found if conv is None else (found & ~conv)
     K1 = int(found_cap) if found_cap else N
     K1 = max(8, min(K1, N))
     if compaction == "mxu" and N >= (1 << 16):
         # (the vals channel could also carry u through the one-hot, but
         # the extra batched dot re-reads the 1 GB one-hot and measured
         # SLOWER than the (K1,) gather below: 87.0 vs 84.2 ms/iter)
-        src1, valid1, over1, pos1 = _compact_mxu(found, K1, compact_block)
+        src1, valid1, over1, pos1 = _compact_mxu(light, K1, compact_block)
     else:
-        src1, valid1, over1, pos1 = _compact(found, K1)
+        src1, valid1, over1, pos1 = _compact(light, K1)
     us = jnp.maximum(u[src1], 0)  # (K1,)
     # ONE (K1, 2) row gather: indexing the columns separately makes XLA
     # emit two serialized point gathers (traced at ~14 ms EACH at 4M/640k)
@@ -1206,6 +1446,7 @@ def pip_join_points(
             px, py, hs, index, heavy_cap, K1, K1, edge_eps2,
             lookup="mxu" if lookup == "mxu2" else "gather",
             compaction=compaction, compact_block=compact_block,
+            engine=heavy_engine,
         )
         best1 = jnp.minimum(best1, best2)
         # an overflowed tier-2 point has an unknown answer even if tier 1
@@ -1215,14 +1456,52 @@ def pip_join_points(
         if banded:
             near1 = near1 | near_sc
 
+    if use_convex:
+        # convex lane: compact, y-bucket, probe at most EB edges/point.
+        # The single-chip eligibility contract makes `parity bit 0 set ->
+        # that chip's geom` exactly _slot_best on the cell's tier-1 row.
+        K3 = int(convex_cap) if convex_cap else N
+        K3 = max(8, min(K3, N))
+        with jax.named_scope("pip.convex"):
+            if compaction == "mxu" and N >= (1 << 16):
+                src3, valid3, over3, pos3 = _compact_mxu(
+                    conv, K3, compact_block
+                )
+            else:
+                src3, valid3, over3, pos3 = _compact(conv, K3)
+            cv3 = jnp.maximum(cvrow[src3], 0)
+            pq3 = points[src3]
+            px3, py3 = pq3[:, 0], pq3[:, 1]
+            yb = index.convex_ybin[cv3]
+            KB = int(index.convex_edges.shape[1])
+            EB = int(index.convex_edges.shape[2])
+            b3 = jnp.clip(
+                jnp.floor((py3 - yb[:, 0]) * yb[:, 1]).astype(jnp.int32),
+                0, KB - 1,
+            )
+            flat3 = cv3 * KB + b3
+            ce = index.convex_edges.reshape(CV * KB, EB, 4)[flat3]
+            cb = index.convex_ebits.reshape(CV * KB, EB)[flat3]
+            r3 = _ray_parity(px3, py3, ce, cb, eps2=edge_eps2)
+            par3, near3 = r3 if banded else (r3, None)
+            g3 = index.convex_geom[cv3]
+            hit3 = ((par3 & jnp.uint32(1)) == 1) & (g3 >= 0) & valid3
+            best3 = jnp.where(hit3, g3, _SENTINEL)
+    else:
+        best3 = near3 = over3 = None
+
     # return compacted results to the full point axis. Valid src1 row ids
     # are unique by construction; invalid slots divert to distinct
     # out-of-bounds destinations that mode="drop" discards — a unique
     # no-combiner scatter (see _compact for the measured win over
-    # combiner scatters).
+    # combiner scatters). The convex lane's rows are disjoint from the
+    # light lane's, so its scatter chains onto the same buffer.
     if writeback == "gather":
         slot = jnp.clip(pos1, 0, K1 - 1)
-        best = jnp.where(found, best1[slot], _SENTINEL)
+        best = jnp.where(light, best1[slot], _SENTINEL)
+        if use_convex:
+            slot3 = jnp.clip(pos3, 0, K3 - 1)
+            best = jnp.where(conv, best3[slot3], best)
     else:
         wdest = jnp.where(
             valid1, src1, N + jnp.arange(K1, dtype=jnp.int32)
@@ -1232,18 +1511,33 @@ def pip_join_points(
             .at[wdest]
             .set(best1, unique_indices=True, mode="drop")
         )
+        if use_convex:
+            wdest3 = jnp.where(
+                valid3, src3, N + jnp.arange(K3, dtype=jnp.int32)
+            )
+            best = best.at[wdest3].set(
+                best3, unique_indices=True, mode="drop"
+            )
     out = jnp.where(best == _SENTINEL, -1, best).astype(jnp.int32)
     out = jnp.where(best == _OVF_MARK, OVERFLOW, out)
     out = jnp.where(over1, OVERFLOW, out)
+    if use_convex:
+        out = jnp.where(over3, OVERFLOW, out)
     if banded:
         if writeback == "gather":
-            near = found & ~over1 & near1[slot]
+            near = light & ~over1 & near1[slot]
+            if use_convex:
+                near = jnp.where(conv, ~over3 & near3[slot3], near)
         else:
             near = (
                 jnp.zeros(N, bool)
                 .at[wdest]
                 .set(near1, unique_indices=True, mode="drop")
             )
+            if use_convex:
+                near = near.at[wdest3].set(
+                    near3, unique_indices=True, mode="drop"
+                )
         return out, near
     return out
 
@@ -1253,7 +1547,7 @@ _JIT_JOIN = jax.jit(
     pip_join_points,
     static_argnames=(
         "heavy_cap", "found_cap", "writeback", "lookup", "compaction",
-        "compact_block",
+        "compact_block", "probe", "convex_cap",
     ),
 )
 
@@ -1377,6 +1671,7 @@ def pip_join(
     lookup: str | None = None,
     cell_margin_k: float | None = None,
     edge_band_k: float | None = None,
+    probe: str = "scatter",
 ) -> np.ndarray:
     """Managed join (reference: `PointInPolygonJoin.join` auto-indexes both
     sides, `sql/join/PointInPolygonJoin.scala:86-97`).
@@ -1423,8 +1718,21 @@ def pip_join(
     the `tools/calibrate_margins.py` sweep knob (wider bands stay exact
     but recheck more; narrower bands below the measured drift ceiling
     lose the exactness contract).
+
+    ``probe="adaptive"`` turns on per-cell density routing (light cells
+    on the tier-1 path, heavy cells through the tiled Pallas kernel,
+    convex single-chip cells through the y-bucketed reduced-edge test) —
+    bit-identical results, a throughput knob. ``adaptive-light`` /
+    ``adaptive-heavy`` / ``adaptive-convex`` pin a single lane (also
+    reachable via ``MOSAIC_PROBE_FORCE_LANE`` when ``probe="adaptive"``);
+    requires a compaction writeback (not ``direct``).
     """
     resolution = index_system.resolution_arg(resolution)
+    probe = resolve_probe_mode(probe)
+    if probe != "scatter" and writeback == "direct":
+        raise ValueError(
+            "probe='adaptive' requires writeback scatter|gather"
+        )
     if chip_index is None:
         table = tessellate(polygons, index_system, resolution, keep_core_geoms=False)
         chip_index = build_chip_index(table)
@@ -1485,8 +1793,9 @@ def pip_join(
             )
             caps = _faults.clamp_caps({"heavy_cap": hcap})
             hcap = caps["heavy_cap"]
+            ccap = None
         else:
-            nf, nh = (
+            nf, nh, nc = (
                 int(v) for v in np.asarray(_JIT_COUNTS(cells, chip_index))
             )
             fcap = min(_next_pow2(nf + 1), chunk.shape[0])
@@ -1495,12 +1804,28 @@ def pip_join(
                 if chip_index.num_heavy_cells
                 else None
             )
+            ccap = (
+                min(_next_pow2(nc + 1), chunk.shape[0])
+                if probe != "scatter" and chip_index.num_convex_cells
+                else None
+            )
             # fault injection may clamp the exactly-sized caps (no-op
             # without an active plan); the escalation loop grows them back
             caps = _faults.clamp_caps(
-                {"found_cap": fcap, "heavy_cap": hcap}
+                {"found_cap": fcap, "heavy_cap": hcap, "convex_cap": ccap}
             )
-            fcap, hcap = caps["found_cap"], caps["heavy_cap"]
+            fcap, hcap, ccap = (
+                caps["found_cap"], caps["heavy_cap"], caps["convex_cap"]
+            )
+            if probe != "scatter":
+                # lane populations for trails/dashboards: how the router
+                # splits this chunk (convex leaves the light lane; heavy
+                # points pay both tier 1 and the Pallas tier 2)
+                _telemetry.record(
+                    "probe_route", n=chunk.shape[0], probe=probe,
+                    found=nf, heavy=nh, convex=nc,
+                    light=nf - nc,
+                )
         shifted = jnp.asarray(chunk - shift, dtype=dtype)
         # every cap that exists escalates together toward the row-count
         # ceiling, at which overflow is structurally impossible
@@ -1522,6 +1847,8 @@ def pip_join(
                             heavy_cap=c.get("heavy_cap", hcap),
                             found_cap=c.get("found_cap", fcap),
                             writeback=writeback, lookup=lookup,
+                            probe=probe,
+                            convex_cap=c.get("convex_cap", ccap),
                         )
                     ),
                 )
@@ -1549,6 +1876,7 @@ def pip_join(
                     heavy_cap=c.get("heavy_cap", hcap),
                     found_cap=c.get("found_cap", fcap), edge_eps2=eps2,
                     writeback=writeback, lookup=lookup,
+                    probe=probe, convex_cap=c.get("convex_cap", ccap),
                 )
                 return np.array(o), np.array(nr)  # writable host copies
 
@@ -1597,9 +1925,11 @@ def pip_join(
                     )
                 else:
                     # exact caps for the narrow join from the band's own
-                    # two scalar counts (pad rows duplicate row 0, so the
-                    # counts upper-bound the real band — still exact)
-                    nf2, nh2 = (
+                    # scalar counts (pad rows duplicate row 0, so the
+                    # counts upper-bound the real band — still exact; the
+                    # rejoin runs the scatter path, so the convex count
+                    # is unused)
+                    nf2, nh2, _ = (
                         int(v)
                         for v in np.asarray(_JIT_COUNTS(alt, chip_index))
                     )
@@ -1656,15 +1986,29 @@ def pip_join(
                 attempts=e.attempts,
             )
 
+    def run_spanned(chunk: np.ndarray) -> np.ndarray:
+        """One lane span per device dispatch when routing is pinned:
+        `join.probe.<lane>` wraps the whole forced-lane dispatch so a
+        trail attributes its wall clock to that lane (the fused
+        `adaptive` program is one dispatch — its lane populations ride
+        the `probe_route` event instead)."""
+        if probe.startswith("adaptive-"):
+            with _obs_trace.span(
+                f"join.probe.{probe.removeprefix('adaptive-')}",
+                n=chunk.shape[0],
+            ):
+                return run_resilient(chunk)
+        return run_resilient(chunk)
+
     # one span per pip_join call: escalation/retry/degradation/recheck
     # events inside attach to it, so a trail shows WHICH join they hit
-    with _obs_trace.span("join.pip", n=n, recheck=bool(recheck)):
+    with _obs_trace.span("join.pip", n=n, recheck=bool(recheck), probe=probe):
         if batch_size is None or n <= batch_size:
-            return run_resilient(raw)
+            return run_spanned(raw)
         out = np.empty(n, dtype=np.int32)
         degraded: list[DegradedResult] = []
         for s in range(0, n, batch_size):
-            r = run_resilient(raw[s : s + batch_size])
+            r = run_spanned(raw[s : s + batch_size])
             if isinstance(r, DegradedResult):
                 degraded.append(r)
             out[s : s + batch_size] = r

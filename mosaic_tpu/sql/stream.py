@@ -71,7 +71,12 @@ from ..runtime import (
 )
 from ..runtime.errors import RetryExhausted
 from ..runtime.retry import call_with_retry
-from .join import ChipIndex, host_join_with_cells, pip_join_points
+from .join import (
+    ChipIndex,
+    host_join_with_cells,
+    pip_join_points,
+    resolve_probe_mode,
+)
 
 
 def fold_stats(out: jax.Array) -> jax.Array:
@@ -212,6 +217,8 @@ class StreamJoin:
         compaction: str | None = None,
         cell_dtype=jnp.float32,
         prefetch: bool = True,
+        probe: str = "scatter",
+        convex_cap: int | None = None,
     ):
         self.index = index
         self.index_system = index_system
@@ -231,6 +238,11 @@ class StreamJoin:
             compaction = "scatter" if platform == "cpu" else "mxu"
         self.lookup, self.compaction = lookup, compaction
         self.found_cap, self.heavy_cap = found_cap, heavy_cap
+        # resolve the adaptive/force-lane knob HERE, before the value is
+        # closed over by the jitted scan (env changes cannot reach a
+        # compiled program; see join.resolve_probe_mode)
+        probe = resolve_probe_mode(probe)
+        self.probe, self.convex_cap = probe, convex_cap
 
         def assign(pts):
             c = index_system.point_to_cell(
@@ -252,6 +264,8 @@ class StreamJoin:
                 found_cap=found_cap,
                 lookup=lookup,
                 compaction=compaction,
+                probe=probe,
+                convex_cap=convex_cap,
             )
 
         self.assign = jax.jit(assign)

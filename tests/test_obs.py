@@ -607,6 +607,7 @@ class TestPerfGate:
             assert key.split(".")[0] in (
                 "serve_stage", "stream_stage", "serve_request",
                 "recheck_narrow", "quarantine_stage", "snapshot_saved",
+                "probe_stage",
             ), key
 
 
@@ -652,3 +653,67 @@ class TestTraceReport:
         d = out["diff"]["bench_stage.compile"]
         assert d["total_ratio"] == pytest.approx(2.0, abs=0.01)
         assert d["share_delta"] > 0
+
+    def test_diff_tolerates_one_sided_stages(self, tmp_path, monkeypatch,
+                                             capsys):
+        """New lanes (e.g. the adaptive probe's probe_stage.* keys) diff
+        cleanly against a historical trail that never emitted them: no
+        throw, null deltas, and an explicit only_in tag each way."""
+        import trace_report
+
+        old = _mk_trail(tmp_path, "old.jsonl", BASE_STAGES)
+        new = _mk_trail(tmp_path, "new.jsonl", {
+            **BASE_STAGES,
+            "probe_light": (0.2, 1),
+            "probe_heavy": (0.4, 1),
+        })
+        monkeypatch.setattr(
+            sys, "argv", ["trace_report.py", new, "--against", old]
+        )
+        trace_report.main()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        d = out["diff"]["bench_stage.probe_heavy"]
+        assert d["only_in"] == "fresh"
+        assert d["share_delta"] is None and d["total_ratio"] is None
+        # and the reverse direction: a stage that vanished
+        monkeypatch.setattr(
+            sys, "argv", ["trace_report.py", old, "--against", new]
+        )
+        trace_report.main()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        d = out["diff"]["bench_stage.probe_heavy"]
+        assert d["only_in"] == "base"
+        assert out["diff"]["bench_stage.compile"].get("only_in") is None
+
+    def test_diff_against_summary_only_artifact(self, tmp_path,
+                                                monkeypatch, capsys):
+        """A bench artifact whose detail.stages is a DICT of per-stage
+        summaries (the perf_gate golden shape) must yield a real base
+        breakdown, not a silently-empty one."""
+        import trace_report
+
+        fresh = _mk_trail(tmp_path, "fresh.jsonl", BASE_STAGES)
+        art = tmp_path / "hist.json"
+        art.write_text(json.dumps({
+            "metric": "m", "value": 1,
+            "detail": {"stages": {
+                "bench_stage.compile": {"total_s": 2.0, "count": 2},
+            }},
+        }) + "\n")
+        monkeypatch.setattr(
+            sys, "argv", ["trace_report.py", fresh, "--against", str(art)]
+        )
+        trace_report.main()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        d = out["diff"]["bench_stage.compile"]
+        assert d.get("only_in") is None
+        assert d["total_ratio"] == pytest.approx(2.0, abs=0.01)
+
+    def test_stage_key_skips_non_dict_and_non_numeric(self):
+        import trace_report
+
+        assert trace_report.stage_key("bench_stage.seconds") is None
+        assert trace_report.stage_key({"seconds": None}) is None
+        assert trace_report.stage_key(
+            {"stage_key": "x", "seconds": 1.0}
+        ) == "x"

@@ -1,0 +1,416 @@
+"""Adaptive per-cell probe routing: the bit-identity contracts.
+
+The router (`sql/join.py pip_join_points(probe="adaptive")`) partitions
+each compacted batch into light / heavy (Pallas `pip_heavy_tiled`,
+interpret mode on CPU) / convex (reduced y-bucketed edge test) lanes.
+What must hold on any backend:
+
+1. every probe mode — fused adaptive and each forced single lane — is
+   bit-identical to the scatter baseline, on adversarial batches
+   (near-edge band, all-heavy, all-light, convex-only) and with the
+   banded (near-mask) outputs included;
+2. `MOSAIC_PROBE_FORCE_LANE` resolves BEFORE jit staging
+   (`resolve_probe_mode`) so the env knob can never produce a stale
+   compiled program;
+3. the standalone kernel equals the `_ray_parity` reference row for
+   row, sentinel semantics included;
+4. heavy_cap/convex_cap overflow carries the OVERFLOW sentinel through
+   the stream fold and the serve scatter-back (the batch path was
+   already pinned), and the managed paths escalate back to exact;
+5. `kernels/pip.py` tiling validation raises `TilingError` instead of
+   miscompiling inside `pallas_call`.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mosaic_tpu.core.geometry import wkt
+from mosaic_tpu.core.index import CustomIndexSystem, GridConf
+from mosaic_tpu.core.tessellate import tessellate
+from mosaic_tpu.kernels.pip import (
+    TilingError,
+    _pad_to,
+    edge_planes,
+    pip_heavy_tiled,
+)
+from mosaic_tpu.runtime import faults, telemetry
+from mosaic_tpu.sql import join as J
+from mosaic_tpu.sql.join import (
+    OVERFLOW,
+    build_chip_index,
+    host_join,
+    pip_join,
+    pip_join_points,
+    resolve_probe_mode,
+)
+
+CUSTOM = CustomIndexSystem(GridConf(-180, 180, -90, 90, 2, 10.0, 10.0))
+RES = 3
+
+
+def _star_wkt(cx=25.0, cy=-14.0, n=240):
+    th = np.linspace(0.0, 2 * np.pi, n, endpoint=False)
+    r = np.where(np.arange(n) % 2 == 0, 4.0, 2.0)
+    x, y = cx + r * np.cos(th), cy + r * np.sin(th)
+    ring = ", ".join(f"{a:.6f} {b:.6f}" for a, b in zip(x, y))
+    return f"POLYGON (({ring}, {x[0]:.6f} {y[0]:.6f}))"
+
+
+ZONES = [
+    "POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1), "
+    "(5 5, 5 8, 8 8, 8 5, 5 5))",
+    "POLYGON ((20 0, 30 0, 30 10, 25 4, 20 10, 20 0))",
+    "MULTIPOLYGON (((-20 -20, -12 -20, -12 -12, -20 -12, -20 -20)), "
+    "((-8 -8, -2 -8, -2 -2, -8 -2, -8 -8)))",
+    "POLYGON ((-24 5, -14 5, -14 15, -24 15, -24 5))",
+    _star_wkt(),  # >32 edges per cell: guaranteed heavy (tier-2) cells
+]
+
+MODES = ("adaptive", "adaptive-light", "adaptive-heavy", "adaptive-convex")
+
+
+@pytest.fixture(scope="module")
+def index():
+    col = wkt.from_wkt(ZONES)
+    ix = build_chip_index(
+        tessellate(col, CUSTOM, RES, keep_core_geoms=False), edge_cap=8
+    )
+    assert ix.num_heavy_cells > 0 and ix.num_convex_cells > 0
+    return ix
+
+
+@pytest.fixture(scope="module")
+def batches(index):
+    """{name: raw (n, 2) f64 points} — one batch per adversarial shape."""
+    rng = np.random.default_rng(3)
+    pts = rng.uniform((-25, -25), (35, 20), (20_000, 2))
+    cells = np.asarray(CUSTOM.point_to_cell(jnp.asarray(pts), RES))
+    ucells = np.asarray(index.cells)
+    u = np.clip(np.searchsorted(ucells, cells), 0, len(ucells) - 1)
+    found = ucells[u] == cells
+    heavy = found & (np.asarray(index.cell_heavy)[u] >= 0)
+    convex = found & (np.asarray(index.cell_convex)[u] >= 0)
+
+    edges = np.asarray(index.cell_edges, dtype=np.float64)
+    ab = edges[np.asarray(index.cell_ebits) != 0]
+    ab = ab[rng.permutation(len(ab))[:800]]
+    a, b = ab[:, 0:2], ab[:, 2:4]
+    mid, t = 0.5 * (a + b), b - a
+    nrm = np.stack([-t[:, 1], t[:, 0]], axis=1)
+    nrm /= np.maximum(np.linalg.norm(nrm, axis=1, keepdims=True), 1e-30)
+    shift = np.asarray(index.border.shift, dtype=np.float64)
+    band = np.concatenate(
+        [mid + d * s * nrm for d in (1e-6, 1e-4) for s in (1, -1)]
+    ) + shift
+
+    out = {
+        "mixed": pts,
+        "all_light": pts[found & ~heavy & ~convex],
+        "all_heavy": pts[heavy],
+        "convex_only": pts[convex],
+        "near_edge_band": band,
+    }
+    for name, batch in out.items():
+        assert len(batch) > 0, name
+    return out
+
+
+def _join(index, pts, probe, **kw):
+    return np.asarray(
+        pip_join(pts, None, CUSTOM, RES, chip_index=index, recheck=False,
+                 probe=probe, **kw)
+    )
+
+
+# ------------------------------------------------- identity, all lanes
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_all_modes_bit_identical_to_scatter(index, batches, mode):
+    for name, pts in batches.items():
+        base = _join(index, pts, "scatter")
+        got = _join(index, pts, mode)
+        np.testing.assert_array_equal(got, base, err_msg=f"{mode}/{name}")
+
+
+def test_adaptive_banded_outputs_identical(index, batches):
+    """The banded variant (near-mask output) of every mode equals the
+    scatter baseline bit for bit — match rows AND band flags."""
+    pts = batches["near_edge_band"]
+    cells = CUSTOM.point_to_cell(jnp.asarray(pts), RES)
+    shifted = jnp.asarray(
+        pts - np.asarray(index.border.shift, np.float64),
+        dtype=index.border.verts.dtype,
+    )
+    eps2 = jnp.asarray(1e-10, index.border.verts.dtype)
+    base, nbase = pip_join_points(
+        shifted, cells, index, edge_eps2=eps2, probe="scatter"
+    )
+    for mode in MODES:
+        m, nm = pip_join_points(
+            shifted, cells, index, edge_eps2=eps2, probe=mode
+        )
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(m), mode)
+        np.testing.assert_array_equal(
+            np.asarray(nbase), np.asarray(nm), mode
+        )
+
+
+def test_adaptive_recheck_equals_host_oracle(index, batches):
+    for name in ("mixed", "near_edge_band"):
+        pts = batches[name]
+        want = host_join(pts, index.host, CUSTOM, RES)
+        got = np.asarray(pip_join(
+            pts, None, CUSTOM, RES, chip_index=index, recheck=True,
+            probe="adaptive",
+        ))
+        np.testing.assert_array_equal(got, want, err_msg=name)
+
+
+def test_route_counts_recorded(index, batches):
+    with telemetry.capture() as events:
+        _join(index, batches["mixed"], "adaptive")
+    routes = [e for e in events if e["event"] == "probe_route"]
+    assert routes and routes[0]["probe"] == "adaptive"
+    r = routes[0]
+    assert r["found"] == r["light"] + r["convex"]
+    assert r["heavy"] > 0 and r["convex"] > 0
+
+
+# --------------------------------------------- env knob / mode plumbing
+
+
+def test_resolve_probe_mode_env_mapping(monkeypatch):
+    monkeypatch.delenv("MOSAIC_PROBE_FORCE_LANE", raising=False)
+    assert resolve_probe_mode("scatter") == "scatter"
+    assert resolve_probe_mode("adaptive") == "adaptive"
+    for lane in ("light", "heavy", "convex"):
+        monkeypatch.setenv("MOSAIC_PROBE_FORCE_LANE", lane)
+        assert resolve_probe_mode("adaptive") == f"adaptive-{lane}"
+        # pinned modes and scatter ignore the knob (idempotent)
+        assert resolve_probe_mode("scatter") == "scatter"
+        assert resolve_probe_mode("adaptive-heavy") == "adaptive-heavy"
+
+
+def test_resolve_probe_mode_rejects_garbage(monkeypatch):
+    with pytest.raises(ValueError, match="probe"):
+        resolve_probe_mode("mxu")
+    monkeypatch.setenv("MOSAIC_PROBE_FORCE_LANE", "turbo")
+    with pytest.raises(ValueError, match="MOSAIC_PROBE_FORCE_LANE"):
+        resolve_probe_mode("adaptive")
+
+
+def test_adaptive_rejects_direct_writeback(index, batches):
+    with pytest.raises(ValueError, match="writeback"):
+        pip_join(batches["mixed"][:64], None, CUSTOM, RES,
+                 chip_index=index, recheck=False, probe="adaptive",
+                 writeback="direct")
+
+
+# ----------------------------------------------- standalone heavy kernel
+
+
+def test_pip_heavy_tiled_matches_ray_parity_reference():
+    """The kernel (interpret mode) equals the `_ray_parity` reference +
+    slot-min merge row for row: parity, band mask, and the int32-max
+    no-hit sentinel for pad rows."""
+    rng = np.random.default_rng(9)
+    H, E2, M2, K = 3, 24, 4, 300
+    # random short edges, each assigned to one slot bit
+    a = rng.uniform(-1, 1, (H, E2, 2))
+    b = a + rng.uniform(-0.5, 0.5, (H, E2, 2))
+    edges = np.concatenate([a, b], axis=2).astype(np.float32)
+    slot = rng.integers(0, M2, (H, E2))
+    bits = (np.uint32(1) << slot.astype(np.uint32)).astype(np.uint32)
+    geom = rng.integers(0, 50, (H, M2)).astype(np.int32)
+    geom[0, 1] = -1  # an empty slot must never win
+    px = rng.uniform(-1, 1, K).astype(np.float32)
+    py = rng.uniform(-1, 1, K).astype(np.float32)
+    rows = rng.integers(0, H, K).astype(np.int32)
+    rows[-7:] = -1  # pad rows
+    eps2 = np.float32(1e-8)
+
+    best, near = pip_heavy_tiled(
+        jnp.asarray(px), jnp.asarray(py), jnp.asarray(rows),
+        jnp.asarray(edges), jnp.asarray(bits), jnp.asarray(geom),
+        eps2=jnp.asarray(eps2), interpret=True,
+    )
+
+    par, ref_near = J._ray_parity(
+        jnp.asarray(px), jnp.asarray(py),
+        jnp.asarray(edges)[np.maximum(rows, 0)],
+        jnp.asarray(bits)[np.maximum(rows, 0)],
+        eps2=jnp.asarray(eps2),
+    )
+    par = np.asarray(par)
+    g = geom[np.maximum(rows, 0)]
+    inside = ((par[:, None] >> np.arange(M2)) & 1).astype(bool) & (g >= 0)
+    sent = np.iinfo(np.int32).max
+    ref = np.where(inside, g, sent).min(axis=1)
+    ref[rows < 0] = sent
+    ref_near = np.asarray(ref_near) & (rows >= 0)
+
+    np.testing.assert_array_equal(np.asarray(best), ref)
+    np.testing.assert_array_equal(np.asarray(near), ref_near)
+
+
+def test_pip_heavy_tiled_rejects_f64_tables():
+    z = jnp.zeros((1,), jnp.float32)
+    with pytest.raises(ValueError, match="float32"):
+        pip_heavy_tiled(
+            z, z, jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1, 8, 4), jnp.float64),
+            jnp.zeros((1, 8), jnp.uint32),
+            jnp.zeros((1, 2), jnp.int32),
+            interpret=True,
+        )
+
+
+# ------------------------------------------------ tiling validation
+
+
+def test_pad_to_refuses_to_shrink():
+    with pytest.raises(TilingError, match="cannot shrink"):
+        _pad_to(np.zeros((4, 4)), 2, axis=0)
+
+
+@pytest.mark.parametrize(
+    "kw", [{"g_pad": 100}, {"g_pad": 0}, {"e_pad": 12}, {"e_pad": 0}]
+)
+def test_edge_planes_rejects_untiled_pads(kw):
+    from mosaic_tpu.core.geometry.device import pack_to_device
+
+    col = wkt.from_wkt(["POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))"])
+    dev = pack_to_device(col, dtype=jnp.float32, recenter=True)
+    with pytest.raises(TilingError, match="multiple"):
+        edge_planes(dev, **kw)
+
+
+def test_pip_heavy_tiled_rejects_untiled_tiles():
+    z = jnp.zeros((16,), jnp.float32)
+    with pytest.raises(TilingError):
+        pip_heavy_tiled(
+            z, z, jnp.zeros((16,), jnp.int32),
+            jnp.zeros((1, 8, 4), jnp.float32),
+            jnp.zeros((1, 8), jnp.uint32),
+            jnp.zeros((1, 2), jnp.int32),
+            tile_g=100, interpret=True,
+        )
+
+
+# ------------------------------- overflow sentinel through the frontends
+
+
+def test_convex_cap_overflow_marks_and_escalates(index, batches):
+    pts = batches["convex_only"][:512]
+    cells = CUSTOM.point_to_cell(jnp.asarray(pts), RES)
+    shifted = jnp.asarray(
+        pts - np.asarray(index.border.shift, np.float64),
+        dtype=index.border.verts.dtype,
+    )
+    tiny = pip_join_points(
+        shifted, cells, index, probe="adaptive", convex_cap=8
+    )
+    assert int((np.asarray(tiny) == OVERFLOW).sum()) > 0
+    # the managed path escalates convex_cap until exact
+    got = _join(index, pts, "adaptive")
+    base = _join(index, pts, "scatter")
+    np.testing.assert_array_equal(got, base)
+    assert not (got == OVERFLOW).any()
+
+
+def test_heavy_overflow_through_stream_fold(index, batches):
+    """A too-small heavy_cap's OVERFLOW sentinel must survive the stream
+    fold: outs carry -2 on exactly the per-batch rows and the folded
+    overflow count equals the per-batch total."""
+    from mosaic_tpu.sql.stream import StreamJoin, ring_from_host
+
+    pts = batches["all_heavy"]
+    n = (len(pts) // 2) * 2
+    host_batches = [pts[: n // 2], pts[n // 2 : n]]
+    sj = StreamJoin(
+        index, CUSTOM, RES, heavy_cap=4, prefetch=False,
+        probe="adaptive",
+    )
+    res = sj.run(ring_from_host(host_batches), 2, collect=True)
+    outs = np.asarray(res.outs)
+    want = [
+        np.asarray(pip_join(
+            b, None, CUSTOM, RES, chip_index=index, recheck=False,
+            batch_size=None,
+        ))
+        for b in host_batches
+    ]
+    per_batch = []
+    for b in host_batches:
+        cells = CUSTOM.point_to_cell(jnp.asarray(b), RES)
+        shifted = jnp.asarray(
+            b - np.asarray(index.border.shift, np.float64),
+            dtype=index.border.verts.dtype,
+        )
+        per_batch.append(np.asarray(pip_join_points(
+            shifted, cells, index, heavy_cap=4, probe="adaptive"
+        )))
+    n_over = sum(int((o == OVERFLOW).sum()) for o in per_batch)
+    assert n_over > 0, "fixture must actually overflow heavy_cap=4"
+    np.testing.assert_array_equal(outs, np.stack(per_batch))
+    assert res.overflow == n_over
+    del want
+
+
+def test_heavy_overflow_through_serve_scatter_back(index, batches):
+    """Serve full-bucket caps never overflow by construction; shrink the
+    heavy cap at the dispatch boundary and assert the OVERFLOW sentinel
+    reaches exactly the right caller rows through pad + scatter-back."""
+    from mosaic_tpu.serve.bucket import BucketLadder
+    from mosaic_tpu.serve.engine import ServeEngine
+
+    pts = batches["all_heavy"][:300]
+    eng = ServeEngine(
+        index, CUSTOM, RES, ladder=BucketLadder(64, 1024),
+        bounds=(-25.0, -25.0, 35.0, 20.0), max_wait_s=0.01,
+        probe="adaptive",
+    )
+    try:
+        clean = np.asarray(eng.join(pts))
+        caps0 = eng._caps
+        eng._caps = lambda bucket: (caps0(bucket)[0], 4, caps0(bucket)[2])
+        eng._signatures.clear()
+        over = np.asarray(eng.join(pts))
+    finally:
+        eng.shutdown() if hasattr(eng, "shutdown") else None
+    bucket = 512  # pts pad to the 512 bucket
+    cells = CUSTOM.point_to_cell(
+        jnp.asarray(np.vstack([pts, np.repeat(pts[:1], bucket - len(pts),
+                                              axis=0)]))
+        , RES)
+    shifted = jnp.asarray(
+        np.vstack([pts, np.repeat(pts[:1], bucket - len(pts), axis=0)])
+        - np.asarray(index.border.shift, np.float64),
+        dtype=index.border.verts.dtype,
+    )
+    want = np.asarray(pip_join_points(
+        shifted, cells, index, found_cap=bucket, heavy_cap=4,
+        probe="adaptive",
+    ))[: len(pts)]
+    assert int((want == OVERFLOW).sum()) > 0
+    np.testing.assert_array_equal(over, want)
+    assert not (clean == OVERFLOW).any()
+
+
+def test_shrunk_caps_fault_escalates_to_exact(index, batches):
+    """faults.shrink_caps on the managed batch path: convex_cap joins
+    found/heavy in the escalation engine and regrows to exact."""
+    pts = batches["mixed"][:4096]
+    base = _join(index, pts, "scatter")
+    with telemetry.capture() as events:
+        with faults.inject(shrink_caps={
+            "found_cap": 8, "heavy_cap": 8, "convex_cap": 8,
+        }):
+            got = _join(index, pts, "adaptive")
+    np.testing.assert_array_equal(got, base)
+    assert any(e["event"] == "capacity_overflow" for e in events) or any(
+        e["event"] == "escalation_resolved" for e in events
+    )

@@ -41,10 +41,16 @@ def stage_key(event: dict) -> str | None:
 
     Timed stage events (``*_stage`` with a ``stage`` field) key as
     ``<event>.<stage>``; span events as ``span.<name>``; any other
-    event carrying ``seconds`` keys as its event name.
+    event carrying a numeric ``seconds`` keys as its event name.
+    Pre-keyed summary pseudo-events (``stage_key``, from summary-only
+    artifacts) pass their key through. Non-dict rows are skipped.
     """
-    if "seconds" not in event:
+    if not isinstance(event, dict):
         return None
+    if not isinstance(event.get("seconds"), (int, float)):
+        return None
+    if "stage_key" in event:
+        return str(event["stage_key"])
     ev = event.get("event", "")
     if ev == "span":
         return f"span.{event.get('name', '')}"
@@ -81,7 +87,10 @@ def stage_breakdown(events) -> dict:
 
 def diff_breakdown(fresh: dict, base: dict) -> dict:
     """Per-stage comparison: share delta and total ratio (None when the
-    stage is missing on either side)."""
+    stage is missing on either side). One-sided stages — a lane that
+    exists in only one trail, e.g. new probe spans diffed against a
+    historical trail — are tolerated and tagged ``only_in`` so
+    consumers need not infer sidedness from null deltas."""
     out = {}
     for key in sorted(set(fresh) | set(base)):
         f, b = fresh.get(key), base.get(key)
@@ -97,6 +106,8 @@ def diff_breakdown(fresh: dict, base: dict) -> dict:
                 else None
             ),
         }
+        if f is None or b is None:
+            entry["only_in"] = "base" if f is None else "fresh"
         out[key] = entry
     return out
 
@@ -152,10 +163,13 @@ def main() -> None:
             key=lambda kv: -(abs(kv[1]["share_delta"] or 0)),
         ):
             fmt = lambda v, p: ("-" if v is None else f"{v:{p}}")  # noqa: E731
+            tag = (
+                f"  ({d['only_in']} only)" if d.get("only_in") else ""
+            )
             w(f"{key:<38} {fmt(d['share'], '7.1%')} "
               f"{fmt(d['base_share'], '7.1%')} "
               f"{fmt(d['share_delta'], '+8.1%')} "
-              f"{fmt(d['total_ratio'], '7.2f')}\n")
+              f"{fmt(d['total_ratio'], '7.2f')}{tag}\n")
 
     line = json.dumps(report)
     sys.stdout.write(line + "\n")
