@@ -1,0 +1,177 @@
+"""Traced-code purity: the two rules guarding the jit staging boundary.
+
+JAX traces a function ONCE per (shape, static-args) signature; anything
+the Python body does besides building the program — host pulls, clocks,
+telemetry, env reads — either runs at trace time only (and silently
+never again: the `MOSAIC_PROBE_FORCE_LANE` stale-program lesson from the
+adaptive-probe PR) or forces a device sync inside a hot loop. The seed
+codebase enforces the discipline by convention (`resolve_probe_mode`
+folds env knobs BEFORE jit; `stream.py` pulls the fold exactly once,
+outside the scan); these rules make it machine-checked.
+
+Traced contexts detected: functions decorated with `@jax.jit` /
+`@partial(jax.jit, ...)`, named functions and lambdas passed to
+``jax.jit(...)``, bodies handed to ``lax.scan`` / ``lax.fori_loop`` /
+``lax.while_loop`` / ``pallas_call``, and (transitively) module-local
+functions called by name from any traced body.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, dotted, functions_by_name, last_attr
+from ..engine import FileContext
+from ..findings import Finding
+from ..registry import rule
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` as a bare expression (decorator or arg)."""
+    name = dotted(node)
+    return bool(name) and name.split(".")[-1] == "jit"
+
+
+def _decorated_jit(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_jit_expr(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            name = call_name(dec)
+            if name.split(".")[-1] == "jit":
+                return True
+            if name.split(".")[-1] == "partial" and any(
+                _is_jit_expr(a) for a in dec.args
+            ):
+                return True
+    return False
+
+
+def traced_nodes(tree: ast.AST) -> list[ast.AST]:
+    """Every function/lambda node whose body JAX traces, including the
+    in-module transitive closure of functions they call by plain name."""
+    by_name = functions_by_name(tree)
+    roots: list[ast.AST] = []
+
+    def mark_arg(arg: ast.AST) -> None:
+        if isinstance(arg, ast.Lambda):
+            roots.append(arg)
+        elif isinstance(arg, ast.Name):
+            roots.extend(by_name.get(arg.id, []))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _decorated_jit(node):
+                roots.append(node)
+        elif isinstance(node, ast.Call):
+            tail = last_attr(node)
+            if tail == "jit" and node.args:
+                mark_arg(node.args[0])
+            elif tail in ("scan", "pallas_call") and node.args:
+                mark_arg(node.args[0])
+            elif tail == "fori_loop" and len(node.args) >= 3:
+                mark_arg(node.args[2])
+            elif tail == "while_loop" and len(node.args) >= 2:
+                mark_arg(node.args[0])
+                mark_arg(node.args[1])
+
+    # transitive closure over plain-name calls within the module
+    seen: set[int] = set()
+    queue = list(roots)
+    marked: list[ast.AST] = []
+    while queue:
+        fn = queue.pop()
+        if id(fn) in seen:
+            continue
+        seen.add(id(fn))
+        marked.append(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                for target in by_name.get(node.func.id, []):
+                    if id(target) not in seen:
+                        queue.append(target)
+    return marked
+
+
+#: host clock calls that force trace-time evaluation or host syncs
+_TIME_FNS = {
+    "time", "perf_counter", "monotonic", "sleep", "process_time",
+    "perf_counter_ns", "monotonic_ns", "time_ns",
+}
+
+
+def _purity_violation(node: ast.Call) -> str | None:
+    name = call_name(node)
+    tail = last_attr(node)
+    if isinstance(node.func, ast.Name) and node.func.id == "print":
+        return "print() under trace runs at trace time only"
+    parts = name.split(".")
+    if len(parts) == 2 and parts[0] == "time" and parts[1] in _TIME_FNS:
+        return f"host clock {name}() under trace"
+    if tail in ("record", "timed") and "telemetry" in name:
+        return f"telemetry {tail}() under trace is a host side effect"
+    if tail == "asarray" and parts[0] in ("np", "numpy", "onp"):
+        return f"{name}() under trace forces a host transfer"
+    if tail == "item" and not node.args and isinstance(
+        node.func, ast.Attribute
+    ):
+        return ".item() under trace forces a device sync"
+    if tail == "block_until_ready":
+        return "block_until_ready() under trace forces a device sync"
+    return None
+
+
+@rule("jit-purity")
+def jit_purity(ctx: FileContext) -> list[Finding]:
+    """No host side effects (print/time/telemetry/np.asarray/.item()/
+    block_until_ready) inside jit-traced functions or lax loop bodies."""
+    out: list[Finding] = []
+    reported: set[tuple[int, str]] = set()
+    for fn in traced_nodes(ctx.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _purity_violation(node)
+            if why and (node.lineno, why) not in reported:
+                reported.add((node.lineno, why))
+                out.append(Finding(
+                    rule="jit-purity", path=ctx.rel, line=node.lineno,
+                    message=why,
+                    hint=(
+                        "hoist the host op outside the traced function "
+                        "(or use jax.debug/io_callback deliberately)"
+                    ),
+                ))
+    return out
+
+
+@rule("env-read-after-staging")
+def env_read_after_staging(ctx: FileContext) -> list[Finding]:
+    """No os.environ reads inside traced code — the value read at trace
+    time is baked into the compiled program and never re-read."""
+    out: list[Finding] = []
+    reported: set[int] = set()
+    for fn in traced_nodes(ctx.tree):
+        for node in ast.walk(fn):
+            is_env = False
+            if isinstance(node, ast.Attribute) and node.attr == "environ":
+                is_env = True
+            elif isinstance(node, ast.Call) and (
+                call_name(node).endswith("getenv")
+            ):
+                is_env = True
+            if is_env and node.lineno not in reported:
+                reported.add(node.lineno)
+                out.append(Finding(
+                    rule="env-read-after-staging", path=ctx.rel,
+                    line=node.lineno,
+                    message=(
+                        "os.environ read inside traced code bakes a "
+                        "stale value into the compiled program"
+                    ),
+                    hint=(
+                        "resolve the knob before jit staging, as "
+                        "sql.join.resolve_probe_mode does"
+                    ),
+                ))
+    return out

@@ -113,7 +113,7 @@ class MosaicContext:
             from .viz import register_kepler_magic
 
             register_kepler_magic()
-        except Exception:  # noqa: BLE001 — notebooks only, never fatal
+        except Exception:  # lint: broad-except-ok (notebooks only, never fatal)
             pass
         return ctx
 

@@ -35,7 +35,7 @@ def try_sql(fn: Callable, *columns, **kwargs):
         args = [c[i] for c in columns]
         try:
             results[i] = fn(*args, **kwargs)
-        except Exception as e:  # noqa: BLE001 — per-row isolation is the point
+        except Exception as e:  # lint: broad-except-ok (per-row isolation is the point; error recorded per row)
             errors[i] = f"{type(e).__name__}: {e}"
     return results, errors
 
@@ -68,7 +68,7 @@ def try_sql_columnar(fn: Callable, *columns, **kwargs):
                     f"columnar fn returned {len(out)} results for "
                     f"{hi - lo} rows"
                 )
-        except Exception as e:  # noqa: BLE001 — isolate by bisection
+        except Exception as e:  # lint: broad-except-ok (bisection isolates the failing row; error recorded)
             if hi - lo == 1:
                 errors[lo] = f"{type(e).__name__}: {e}"
                 return

@@ -21,11 +21,12 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.geometry.device import DeviceGeometry, take_rows
+from ..runtime import telemetry as _telemetry
 from ._compat import shard_map as _shard_map
 from .dist_overlay import geom_specs
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=8)
 def _sharded_distance_fn(mesh: Mesh):
     """One jitted shard_map per mesh — KNN calls this every ring
     iteration, so the jit object must persist for XLA's trace cache to
@@ -67,3 +68,39 @@ def distributed_pair_distances(
     cip = np.concatenate([ci, np.zeros(npad - n, dtype=ci.dtype)])
     out = _sharded_distance_fn(mesh)(dl, dc, lip, cip)
     return np.asarray(out, dtype=np.float64)[:n]
+
+
+def knn_cache_stats(emit: bool = True) -> dict:
+    """Observability for the per-mesh distance-program cache, mirroring
+    ``sql.join.join_cache_stats``.
+
+    ``{"sharded_distance": {hits, misses, maxsize, currsize}}`` — each
+    live entry pins one jitted shard_map program (and its `Mesh` key)
+    for the cache's lifetime. The lru is bounded (maxsize 8: a process
+    rarely cycles more than a couple of mesh shapes; eviction just costs
+    one recompile on the next ring iteration over that mesh). Emits one
+    ``knn_cache_stats`` telemetry event (``emit=False`` reads silently).
+    """
+    info = _sharded_distance_fn.cache_info()
+    stats = {
+        "sharded_distance": {
+            "hits": info.hits,
+            "misses": info.misses,
+            "maxsize": info.maxsize,
+            "currsize": info.currsize,
+        },
+    }
+    if emit:
+        _telemetry.record("knn_cache_stats", **stats)
+    return stats
+
+
+def clear_knn_caches() -> dict:
+    """Drop every cached per-mesh distance program; returns the
+    pre-clear :func:`knn_cache_stats`. The next ring iteration per mesh
+    pays one recompile. Emits ``knn_caches_cleared`` telemetry.
+    """
+    stats = knn_cache_stats(emit=False)
+    _sharded_distance_fn.cache_clear()
+    _telemetry.record("knn_caches_cleared", **stats)
+    return stats

@@ -176,8 +176,8 @@ class H5Lite:
                 try:
                     k, v = self._parse_attr(mp)
                     info["attrs"][k] = v
-                except Exception:
-                    pass  # attrs are best-effort (densely stored ones skip)
+                except Exception:  # lint: broad-except-ok (attrs are best-effort; densely stored ones skip)
+                    pass
         if "shape" not in info or "dtype" not in info:
             raise ValueError(f"dataset {name!r} missing dataspace/datatype")
         return info

@@ -200,7 +200,7 @@ def _read_prj_srid(path: Path) -> int:
         from ..core.crs_wkt import register_prj_text
 
         return register_prj_text(text)
-    except Exception:
+    except Exception:  # lint: broad-except-ok (WKT registry miss falls back to the keyword heuristic)
         up = text.upper()
         if "OSGB" in up or "27700" in up:
             return 27700

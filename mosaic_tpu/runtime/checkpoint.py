@@ -132,7 +132,7 @@ def load_latest(
                 raise ValueError("content hash mismatch")
             with np.load(npz_path) as z:
                 arrays = {k: np.array(z[k]) for k in z.files}
-        except Exception as e:  # noqa: BLE001 — any damage means skip
+        except Exception as e:  # lint: broad-except-ok (any damage means skip; emits snapshot_corrupt_skipped)
             telemetry.record(
                 "snapshot_corrupt_skipped", run_dir=run_dir, step=step,
                 error=repr(e)[:200],

@@ -128,7 +128,7 @@ def _install_meter() -> None:
 
         monitoring.register_event_duration_secs_listener(_on_duration)
         _METER["available"] = True
-    except Exception:
+    except Exception:  # lint: broad-except-ok (xla monitoring listener is optional; meter reports unavailable)
         _METER["available"] = False
 
 

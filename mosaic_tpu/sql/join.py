@@ -1297,7 +1297,16 @@ def pip_join_points(
             f"compact_block must be a multiple of 128 (TPU lane width), "
             f"got {compact_block}"
         )
-    probe = resolve_probe_mode(probe)
+    # validate only — no env fold here: this function is jit-traced
+    # (`_JIT_JOIN` keys its compile cache on the UNRESOLVED `probe`
+    # static arg), so reading MOSAIC_PROBE_FORCE_LANE at this point
+    # would bake the first-seen lane into the cached program. Host-side
+    # entry points (pip_join, stream, serve, dist_join) fold the knob
+    # via `resolve_probe_mode` before staging.
+    if probe not in _probe_modes():
+        raise ValueError(
+            f"probe must be one of {_probe_modes()}, got {probe!r}"
+        )
     adaptive = probe != "scatter"
     if adaptive and writeback == "direct":
         raise ValueError(
@@ -1607,8 +1616,8 @@ def join_cache_stats(emit: bool = True) -> dict:
 def _jit_cache_size(fn) -> int:
     try:
         return int(fn._cache_size())
-    except Exception:
-        return -1  # jax version without the introspection hook
+    except Exception:  # lint: broad-except-ok (jax version without the introspection hook; -1 means unknown)
+        return -1
 
 
 def clear_join_caches() -> dict:
@@ -1629,10 +1638,10 @@ def clear_join_caches() -> dict:
     for fn in (_JIT_JOIN, _JIT_COMPACT):
         try:
             fn.clear_cache()
-        except Exception:  # older jax spells it _clear_cache
+        except Exception:  # lint: broad-except-ok (older jax spells it _clear_cache)
             try:
                 fn._clear_cache()
-            except Exception:
+            except Exception:  # lint: broad-except-ok (no clear hook on this jax; cache drops at process exit)
                 pass
     _telemetry.record("join_caches_cleared", **stats)
     return stats

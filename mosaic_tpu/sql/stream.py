@@ -142,7 +142,7 @@ def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
     dev = device if device is not None else jax.devices()[0]
     try:
         st = dev.memory_stats() or {}
-    except Exception:
+    except Exception:  # lint: broad-except-ok (memory_stats is optional backend introspection; census fallback below)
         st = {}
     for key in ("peak_bytes_in_use", "bytes_in_use", "bytes_used"):
         v = int(st.get(key, 0) or 0)
@@ -154,12 +154,12 @@ def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
     total = 0
     try:
         arrays = list(jax.live_arrays())
-    except Exception:
+    except Exception:  # lint: broad-except-ok (live_arrays is version-dependent; fall back to the tracked arrays)
         arrays = list(fallback_arrays)
     for a in arrays:
         try:
             total += int(a.nbytes)
-        except Exception:
+        except Exception:  # lint: broad-except-ok (deleted/donated buffers raise on nbytes; skip them)
             pass
     _metrics.gauge("stream.hbm_peak_bytes").set(
         total, source="live_buffer_census"

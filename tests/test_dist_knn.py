@@ -39,6 +39,35 @@ def test_mesh_knn_equals_single_device(devices, n_devices):
     assert rm.metrics["match_count"] == r1.metrics["match_count"]
 
 
+def test_knn_cache_stats_and_clear(devices):
+    from mosaic_tpu.parallel.dist_knn import (
+        clear_knn_caches, knn_cache_stats,
+    )
+    from mosaic_tpu.runtime import telemetry
+
+    h3 = H3IndexSystem()
+    lm, _ = _points(5, seed=3)
+    cd, _ = _points(33, seed=4)
+    clear_knn_caches()
+    with telemetry.capture() as events:
+        SpatialKNN(
+            index=h3, resolution=RES, k_neighbours=3, max_iterations=6,
+            mesh=make_mesh(2),
+        ).transform(lm, cd)
+        stats = knn_cache_stats()
+    dist = stats["sharded_distance"]
+    assert dist["currsize"] == 1  # one mesh -> one cached program
+    assert dist["maxsize"] == 8   # bounded (was maxsize=None)
+    assert dist["hits"] >= 1      # ring iterations share the program
+    assert any(e["event"] == "knn_cache_stats" for e in events)
+
+    with telemetry.capture() as events:
+        pre = clear_knn_caches()
+    assert pre["sharded_distance"]["currsize"] == 1
+    assert knn_cache_stats(emit=False)["sharded_distance"]["currsize"] == 0
+    assert any(e["event"] == "knn_caches_cleared" for e in events)
+
+
 def test_mesh_knn_matches_bruteforce(devices):
     h3 = H3IndexSystem()
     lm, lxy = _points(7, seed=5)

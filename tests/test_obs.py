@@ -100,7 +100,7 @@ class TestSpans:
                     with obs.span("worker_span"):
                         pass
 
-                t = threading.Thread(target=work)
+                t = threading.Thread(target=work)  # lint: thread-context-adoption-ok (this IS the adoption test fixture; no fault plans in scope)
                 t.start()
                 t.join()
         spans = {e["name"]: e for e in events if e["event"] == "span"}

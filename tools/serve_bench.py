@@ -169,7 +169,7 @@ def main() -> None:
                                 shed_submit += 1
 
                 threads = [
-                    threading.Thread(target=worker, daemon=True)
+                    threading.Thread(target=worker, daemon=True)  # lint: thread-context-adoption-ok (load generator: each submit captures its own request context; engine threads adopt downstream)
                     for _ in range(max(args.concurrency, 1))
                 ]
                 for t in threads:
