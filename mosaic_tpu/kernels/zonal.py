@@ -1,0 +1,193 @@
+"""Segment-reduced zonal statistics kernels.
+
+Two lanes with one contract — fold (count, sum, min, max) of masked
+pixel values grouped by a segment id, where segment ``-1`` means "this
+pixel folds nowhere" (nodata, tile pad, or no containing zone):
+
+- :func:`zonal_fold` — the jnp segment-reduce twin. Traceable inside
+  any outer jit, dtype-polymorphic, and the holder of the f64
+  bit-identity contract on CPU (x64): XLA's CPU scatter applies updates
+  sequentially in row order, so an f64 fold here is bit-identical to a
+  sequential numpy accumulation in the same pixel order — which is
+  exactly what the host oracle in `raster/zonal.py` computes.
+- :func:`zonal_tiled` — the Pallas TPU lane (f32, like every Mosaic
+  kernel: no f64 path on the MXU/VPU). Grid is (segment blocks, pixel
+  blocks) with pixels innermost, so each (1, TILE_S) accumulator block
+  stays resident in VMEM while every pixel block streams past it; a
+  pixel block broadcasts against the segment-lane iota and folds with
+  one VPU reduction per statistic. Counts accumulate in f32 — exact up
+  to 2**24 pixels per segment, a documented bound enforced at call
+  time via ``max_count``.
+
+Pixel values are expected pre-masked (pad/nodata pixels carry value 0
+AND segment -1, see `raster/tiles.py`): correctness only needs the
+segment to be -1, the zero value just keeps NaN/Inf garbage out of the
+``sum`` multiply.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pip import TilingError
+
+__all__ = ["zonal_fold", "zonal_tiled", "TilingError"]
+
+#: inert fill for min/max lanes — far beyond any geographic or sensor
+#: value, well inside f32 range (same constant family as kernels/pip.py)
+_BIG_F = 1e30
+
+_I0 = np.int32(0)  # index-map literal: python 0 traces as i64 under x64
+
+
+# ------------------------------------------------------------ jnp lane
+
+
+def zonal_fold(values, seg, num_segments: int, *, acc_dtype=None):
+    """((S,) i32 count, (S,) sum, (S,) min, (S,) max) of ``values``
+    grouped by ``seg`` (-1 folds nowhere). Empty segments report
+    count 0, sum 0, min +inf, max -inf — callers mask on count.
+
+    ``acc_dtype`` picks the accumulator (default: the value dtype; the
+    zonal frontends stage f64 under x64 for the oracle contract).
+    """
+    values = jnp.asarray(values).reshape(-1)
+    seg = jnp.asarray(seg, jnp.int32).reshape(-1)
+    dt = jnp.dtype(acc_dtype) if acc_dtype is not None else values.dtype
+    av = values.astype(dt)
+    ns = int(num_segments) + 1  # one overflow bucket for seg == -1
+    valid = seg >= 0
+    segc = jnp.where(valid, seg, np.int32(num_segments))
+    zero = jnp.zeros((), dt)
+    cnt = jax.ops.segment_sum(
+        valid.astype(jnp.int32), segc, num_segments=ns
+    )
+    s = jax.ops.segment_sum(
+        jnp.where(valid, av, zero), segc, num_segments=ns
+    )
+    mn = jax.ops.segment_min(
+        jnp.where(valid, av, jnp.inf), segc, num_segments=ns
+    )
+    mx = jax.ops.segment_max(
+        jnp.where(valid, av, -jnp.inf), segc, num_segments=ns
+    )
+    k = int(num_segments)
+    return cnt[:k], s[:k], mn[:k], mx[:k]
+
+
+# --------------------------------------------------------- Pallas lane
+
+
+def _zonal_kernel(seg_ref, vals_ref, cnt_ref, sum_ref, min_ref, max_ref,
+                  *, tile_n: int, tile_s: int):
+    s_blk = pl.program_id(0)
+    p_blk = pl.program_id(1)
+
+    @pl.when(p_blk == 0)
+    def _init():  # first pixel block of each segment block zeroes
+        cnt_ref[:] = jnp.zeros((1, tile_s), jnp.float32)
+        sum_ref[:] = jnp.zeros((1, tile_s), jnp.float32)
+        min_ref[:] = jnp.full((1, tile_s), _BIG_F, jnp.float32)
+        max_ref[:] = jnp.full((1, tile_s), -_BIG_F, jnp.float32)
+
+    with jax.named_scope("zonal_fold_block"):
+        lane = (
+            jax.lax.broadcasted_iota(jnp.int32, (tile_n, tile_s), 1)
+            + s_blk * np.int32(tile_s)
+        )
+        seg = seg_ref[:]  # (tile_n, 1) int32, -1 = fold nowhere
+        vals = vals_ref[:]  # (tile_n, 1) f32, 0 at masked pixels
+        belongs = seg == lane  # (tile_n, tile_s) one-hot over lanes
+        bf = belongs.astype(jnp.float32)
+        cnt_ref[:] = cnt_ref[:] + jnp.sum(bf, axis=0, keepdims=True)
+        sum_ref[:] = sum_ref[:] + jnp.sum(
+            vals * bf, axis=0, keepdims=True
+        )
+        min_ref[:] = jnp.minimum(
+            min_ref[:],
+            jnp.min(jnp.where(belongs, vals, _BIG_F), axis=0,
+                    keepdims=True),
+        )
+        max_ref[:] = jnp.maximum(
+            max_ref[:],
+            jnp.max(jnp.where(belongs, vals, -_BIG_F), axis=0,
+                    keepdims=True),
+        )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_segments", "tile_n", "tile_s", "interpret"),
+)
+def zonal_tiled(
+    values,
+    seg,
+    num_segments: int,
+    *,
+    tile_n: int = 2048,
+    tile_s: int = 128,
+    interpret: bool = False,
+):
+    """Pallas TPU zonal fold: ((S,) i32 count, (S,) f32 sum, (S,) f32
+    min, (S,) f32 max). Same contract as :func:`zonal_fold` at f32.
+
+    Pixels are padded to a ``tile_n`` multiple (pad segment -1),
+    segments to a ``tile_s`` multiple; grid (segment blocks, pixel
+    blocks) with pixels innermost so each accumulator block is written
+    by consecutive grid steps. ``interpret=True`` is the CPU twin the
+    tests pin against the jnp lane.
+    """
+    if tile_n % 8 or tile_s % 128:
+        raise TilingError(
+            f"tile_n must be a multiple of 8 and tile_s of 128, got "
+            f"({tile_n}, {tile_s})"
+        )
+    values = jnp.asarray(values, jnp.float32).reshape(-1)
+    seg = jnp.asarray(seg, jnp.int32).reshape(-1)
+    n = values.shape[0]
+    if n > (1 << 24):
+        raise TilingError(
+            f"{n} pixels exceeds the f32-exact count bound 2**24 — "
+            "fold per tile and merge, or use zonal_fold"
+        )
+    n_pad = -(-max(n, 1) // tile_n) * tile_n
+    s_pad = -(-max(int(num_segments), 1) // tile_s) * tile_s
+    vals_p = jnp.zeros((n_pad, 1), jnp.float32).at[:n, 0].set(values)
+    seg_p = jnp.full((n_pad, 1), np.int32(-1)).at[:n, 0].set(seg)
+    grid = (s_pad // tile_s, n_pad // tile_n)
+
+    def pix_spec():
+        return pl.BlockSpec(
+            (tile_n, 1), lambda s, p: (p, _I0),
+            memory_space=pltpu.VMEM,
+        )
+
+    def acc_spec():
+        return pl.BlockSpec(
+            (1, tile_s), lambda s, p: (s, _I0),
+            memory_space=pltpu.VMEM,
+        )
+
+    out_shape = jax.ShapeDtypeStruct((s_pad // tile_s, tile_s),
+                                     jnp.float32)
+    cnt, s, mn, mx = pl.pallas_call(
+        functools.partial(_zonal_kernel, tile_n=tile_n, tile_s=tile_s),
+        grid=grid,
+        in_specs=[pix_spec(), pix_spec()],
+        out_specs=(acc_spec(), acc_spec(), acc_spec(), acc_spec()),
+        out_shape=(out_shape, out_shape, out_shape, out_shape),
+        interpret=interpret,
+    )(seg_p, vals_p)
+    k = int(num_segments)
+    return (
+        cnt.reshape(-1)[:k].astype(jnp.int32),
+        s.reshape(-1)[:k],
+        mn.reshape(-1)[:k],
+        mx.reshape(-1)[:k],
+    )

@@ -9,5 +9,38 @@ per-pixel JVM callbacks.
 """
 
 from .core import Raster, RasterBand, read_raster, write_geotiff  # noqa: F401
+from .tiles import (  # noqa: F401
+    TilePlan,
+    assign_tile_cells,
+    default_tile_shape,
+    plan_tiles,
+    stack_tiles,
+    tile_centers,
+)
+from .zonal import (  # noqa: F401
+    ZonalEngine,
+    ZonalResult,
+    host_zonal_grid_oracle,
+    host_zonal_zones_oracle,
+    zonal_grid,
+    zonal_zones,
+)
 
-__all__ = ["Raster", "RasterBand", "read_raster", "write_geotiff"]
+__all__ = [
+    "Raster",
+    "RasterBand",
+    "TilePlan",
+    "ZonalEngine",
+    "ZonalResult",
+    "assign_tile_cells",
+    "default_tile_shape",
+    "host_zonal_grid_oracle",
+    "host_zonal_zones_oracle",
+    "plan_tiles",
+    "read_raster",
+    "stack_tiles",
+    "tile_centers",
+    "write_geotiff",
+    "zonal_grid",
+    "zonal_zones",
+]

@@ -271,11 +271,40 @@ def _parse_gdal_meta(xml: str, band: "int | None") -> dict[str, str]:
 
 # --------------------------------------------------------------------- IO
 
+#: native decoder failure taxonomy (must mirror the rc codes returned by
+#: `mg_tiff_read` in native/src/tiff.cpp — each early-return there has a
+#: row here, so a typed RasterDecodeError always carries the native
+#: meaning, not just a number)
+_DECODE_ERRORS = {
+    -1: "out of memory decoding pixel planes",
+    -2: "not a TIFF (bad magic/byte-order header)",
+    -3: "BigTIFF is not supported by the native engine",
+    -4: "corrupt or truncated IFD",
+    -5: "bad image dimensions",
+    -6: "unsupported bit depth / sample format",
+    -7: "bad strip/tile geometry",
+    -8: "chunk table shorter than the image demands",
+    -9: "strip/tile decode failed (compression or predictor)",
+    -10: "cannot open file",
+    -11: "short read (file truncated?)",
+    -12: "floating-point predictor (3) is not supported",
+}
+
 
 def read_raster(path: str) -> Raster:
     """Decode a raster by format (reference: RasterAPI.raster /
     `MosaicRasterGDAL.readRaster:182-187`): GeoTIFF through the native
-    engine, GRIB2 through the pure-host decoder."""
+    engine, GRIB2 through the pure-host decoder.
+
+    A nonzero native rc raises a typed
+    :class:`~mosaic_tpu.runtime.errors.RasterDecodeError` carrying the
+    decoder's failure taxonomy; the native pixel/meta buffers are
+    released on every exit path (the ``rc == 0`` branch owns two mallocs
+    that must not leak even if the numpy copy throws).
+    """
+    from ..runtime import faults as _faults
+    from ..runtime.errors import RasterDecodeError
+
     low = str(path).lower()
     if low.endswith((".grib", ".grib2", ".grb", ".grb2")):
         from ..readers.grib2 import read_grib2
@@ -285,6 +314,7 @@ def read_raster(path: str) -> Raster:
         from ..readers.hdf5_lite import read_netcdf
 
         return read_netcdf(str(path))
+    _faults.maybe_fail("raster.decode")
     l = _lib()
     iinfo = (ctypes.c_int64 * 7)()
     dinfo = (ctypes.c_double * 8)()
@@ -294,17 +324,31 @@ def read_raster(path: str) -> Raster:
         str(path).encode(), iinfo, dinfo, ctypes.byref(px), ctypes.byref(meta)
     )
     if rc != 0:
-        raise ValueError(f"cannot read GeoTIFF {path!r} (code {rc})")
-    w, h, bands, dt, has_nd, pages, _meta_len = (int(v) for v in iinfo)
-    dtype = _DTYPES[dt]
-    n = bands * h * w * np.dtype(dtype).itemsize
-    buf = ctypes.string_at(px, n)
-    l.mg_tiff_free(px)
-    data = np.frombuffer(buf, dtype=dtype).reshape(bands, h, w).copy()
-    # meta is malloc'd in C; .value copies the bytes, then free the original
-    meta_xml = meta.value.decode("utf-8", "replace") if meta.value else ""
-    if meta.value is not None:
-        l.mg_tiff_free(meta)
+        # the native engine frees its own partial state on error paths,
+        # but a defensive free here is safe (mg_tiff_free(NULL) is a
+        # no-op) and keeps the invariant local: no exit leaks
+        if px:
+            l.mg_tiff_free(px)
+        if meta.value is not None:
+            l.mg_tiff_free(meta)
+        why = _DECODE_ERRORS.get(rc, "unknown decoder failure")
+        raise RasterDecodeError(
+            f"cannot read GeoTIFF {path!r}: {why} (native rc {rc})",
+            path=str(path), rc=rc,
+        )
+    try:
+        w, h, bands, dt, has_nd, pages, _meta_len = (int(v) for v in iinfo)
+        dtype = _DTYPES[dt]
+        n = bands * h * w * np.dtype(dtype).itemsize
+        buf = ctypes.string_at(px, n)
+        data = np.frombuffer(buf, dtype=dtype).reshape(bands, h, w).copy()
+        meta_xml = (
+            meta.value.decode("utf-8", "replace") if meta.value else ""
+        )
+    finally:
+        l.mg_tiff_free(px)
+        if meta.value is not None:
+            l.mg_tiff_free(meta)
     return Raster(
         data=data,
         gt=tuple(float(dinfo[i]) for i in range(6)),

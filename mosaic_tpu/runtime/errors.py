@@ -101,6 +101,27 @@ class Overloaded(MosaicRuntimeError):
         self.elapsed_s = elapsed_s
 
 
+class RasterDecodeError(MosaicRuntimeError, ValueError):
+    """The native GeoTIFF engine rejected a file.
+
+    Raised by :func:`mosaic_tpu.raster.read_raster` whenever
+    ``mg_tiff_read`` returns a nonzero rc — the rc is mapped to the
+    decoder's failure taxonomy (``native/src/tiff.cpp``) and carried
+    alongside the path, so callers can distinguish "not a TIFF" from
+    "unsupported layout" from plain IO failure. A decode failure is a
+    property of the bytes on disk, never transient: it is excluded from
+    the retry path by construction (``is_transient`` returns False).
+    Also a ``ValueError`` because the decode path raised plain
+    ``ValueError`` before the typed taxonomy existed — callers catching
+    that keep working.
+    """
+
+    def __init__(self, message: str, *, path: str = "", rc: int = 0):
+        super().__init__(message)
+        self.path = path
+        self.rc = rc
+
+
 class RetryExhausted(MosaicRuntimeError):
     """The bounded transient-retry budget ran out without a success.
 
@@ -140,7 +161,10 @@ def is_transient(exc: BaseException) -> bool:
     marker (programming errors like ValueError/TypeError never are)."""
     if isinstance(exc, TransientDeviceError):
         return True
-    if isinstance(exc, (ValueError, TypeError, KeyError, AttributeError)):
+    if isinstance(
+        exc, (ValueError, TypeError, KeyError, AttributeError,
+              RasterDecodeError)
+    ):
         return False
     text = repr(exc).lower()
     return any(m in text for m in _TRANSIENT_MARKERS)

@@ -2,6 +2,7 @@
 
 from .join import ChipIndex, build_chip_index, pip_join, pip_join_points
 from .overlay import intersects_join, overlay_join
+from .raster_stream import RasterScanResult, RasterStream
 from .stream import (
     StreamJoin,
     StreamResult,
@@ -13,6 +14,8 @@ from .stream import (
 
 __all__ = [
     "ChipIndex",
+    "RasterScanResult",
+    "RasterStream",
     "StreamJoin",
     "StreamResult",
     "build_chip_index",

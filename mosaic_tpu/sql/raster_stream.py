@@ -1,0 +1,343 @@
+"""Durable raster scans: the tile twin of `sql/stream.py`.
+
+A MODIS-scale zonal scan is minutes of device time over thousands of
+tiles — long enough that device loss mid-scan is an operational
+certainty, exactly the regime `StreamJoin.run_durable` was built for.
+This module reuses that machinery for tiles: the scan runs in segments
+of ``snapshot_every`` tiles, persisting the fold accumulators (count /
+sum / min / max per zone, all f64-exact) and the tile cursor to a
+checksummed snapshot (`runtime/checkpoint.py`) after each segment. Kill
+the process anywhere and :meth:`RasterStream.resume` finishes the scan
+— converging to a final fold bit-identical to the uninterrupted run,
+because the accumulators snapshot exactly and the tile order (row-major
+over the tile grid, the `raster/zonal.py` contract) is deterministic.
+
+Resilience per segment matches the point stream: each device dispatch
+sits under the ``raster.zonal`` watchdog deadline and the bounded
+transient-retry budget; past the budget the segment's tiles degrade to
+the f64 host twin (`host_zone_partial`), which is bit-identical to the
+device partial, so degradation changes latency, never the answer.
+
+Tracing: one ``raster.scan`` span per durable run, its context
+persisted in every snapshot sidecar so a resume JOINS the killed run's
+trace instead of starting a fresh one.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from ..obs import trace as _trace
+from ..runtime import (
+    checkpoint as _checkpoint,
+    telemetry as _telemetry,
+    watchdog as _watchdog,
+)
+from ..runtime.errors import RetryExhausted
+from ..runtime.retry import call_with_retry
+
+__all__ = ["RasterScanResult", "RasterStream"]
+
+
+def _zonal():
+    """`raster/zonal.py`, imported lazily: that module composes on the
+    join layer of THIS package, so a module-level import here would be
+    a cycle (sql → raster_stream → raster.zonal → sql)."""
+    from ..raster import tiles, zonal
+
+    return tiles, zonal
+
+
+@dataclasses.dataclass
+class RasterScanResult:
+    """One durable raster scan: the zonal fold + durability metrics
+    (``snapshots`` written, ``degraded_tiles`` answered by the host
+    twin, ``resumed_from`` tile cursor when this call was a resume)."""
+
+    stats: "ZonalResult"  # noqa: F821 — resolved lazily, see _zonal()
+    ntiles: int
+    pixels: int
+    wall_s: float
+    pixels_per_sec: float
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+
+class RasterStream:
+    """Durable tiled zonal-statistics scans against one ChipIndex.
+
+    Construction compiles nothing; the per-tile fold executables live
+    in the wrapped :class:`~mosaic_tpu.raster.zonal.ZonalEngine` and are
+    keyed by tile shape, so every raster with the same tile shape
+    replays the same programs.
+    """
+
+    def __init__(
+        self,
+        chip_index,
+        index_system,
+        resolution: int,
+        *,
+        found_cap: "int | None" = None,
+        heavy_cap: "int | None" = None,
+        lookup: str = "gather",
+        compaction: str = "scatter",
+        probe: str = "adaptive",
+        convex_cap: "int | None" = None,
+    ):
+        # the stream always folds on the f64-capable jnp lane — the
+        # durable contract is bit-identity through kill/resume, and the
+        # f32 Pallas lane only holds it on exact-summable data
+        _tiles, zonal = _zonal()
+        self.engine = zonal.ZonalEngine(
+            index_system, resolution, chip_index=chip_index,
+            found_cap=found_cap, heavy_cap=heavy_cap, lookup=lookup,
+            compaction=compaction, probe=probe, convex_cap=convex_cap,
+            lane="fold",
+        )
+        self.chip_index = chip_index
+        self.index_system = index_system
+        self.resolution = int(resolution)
+
+    @property
+    def num_zones(self) -> int:
+        return self.engine.num_zones
+
+    # -------------------------------------------------------------- API
+    def scan(
+        self,
+        raster,
+        *,
+        band: int = 1,
+        tile: "tuple[int, int] | None" = None,
+        run_dir: "str | None" = None,
+        snapshot_every: int = 8,
+        watchdog_default_s: float = 600.0,
+        retry_policy=None,
+    ) -> RasterScanResult:
+        """Scan one band into per-zone (count, sum, min, max). With
+        ``run_dir`` the scan is durable: interrupt anywhere and
+        :meth:`resume` finishes it."""
+        return self._run(
+            raster, band=band, tile=tile, run_dir=run_dir,
+            snapshot_every=int(snapshot_every), start_tile=0, acc0=None,
+            resumed_from=None, watchdog_default_s=watchdog_default_s,
+            retry_policy=retry_policy, trace_parent=None,
+        )
+
+    def resume(
+        self,
+        run_dir: str,
+        raster,
+        *,
+        watchdog_default_s: float = 600.0,
+        retry_policy=None,
+    ) -> RasterScanResult:
+        """Restart an interrupted durable scan from the newest VALID
+        snapshot under ``run_dir``. The snapshot's raster fingerprint,
+        tile shape, band, and zone count must match — resuming a fold
+        against different pixels would silently merge garbage."""
+        loaded = _checkpoint.load_latest(run_dir)
+        if loaded is None:
+            raise FileNotFoundError(
+                f"no valid snapshot under {run_dir!r} — nothing to resume"
+            )
+        step, arrays, meta = loaded
+        want_fp = meta.get("raster_sha256")
+        if want_fp and want_fp != _checkpoint.fingerprint(
+            np.ascontiguousarray(raster.data)
+        ):
+            raise ValueError(
+                "snapshot raster fingerprint mismatch — this is not "
+                "the raster the interrupted scan was folding"
+            )
+        if int(meta.get("num_zones", self.num_zones)) != self.num_zones:
+            raise ValueError(
+                f"snapshot zone count {meta.get('num_zones')} != this "
+                f"stream's {self.num_zones}"
+            )
+        tile = tuple(meta["tile"]) if meta.get("tile") else None
+        return self._run(
+            raster, band=int(meta.get("band", 1)), tile=tile,
+            run_dir=run_dir,
+            snapshot_every=int(meta.get("snapshot_every", 8)),
+            start_tile=int(step),
+            acc0={k: np.asarray(v) for k, v in arrays.items()},
+            resumed_from=int(step),
+            watchdog_default_s=watchdog_default_s,
+            retry_policy=retry_policy,
+            trace_parent=_trace.SpanContext.from_dict(meta.get("trace")),
+        )
+
+    # ------------------------------------------------------------ engine
+    def _run(
+        self, raster, *, band, tile, run_dir, snapshot_every,
+        start_tile, acc0, resumed_from, watchdog_default_s,
+        retry_policy, trace_parent,
+    ) -> RasterScanResult:
+        tiles, _zn = _zonal()
+        plan = tiles.plan_tiles(raster, tile)
+        th, tw = plan.shape
+        g = self.num_zones
+        snapshot_every = max(1, int(snapshot_every))
+        root = _trace.start_span(
+            "raster.scan",
+            parent=trace_parent,
+            ntiles=plan.ntiles, th=th, tw=tw, band=band,
+            zones=g, resumed_from=resumed_from,
+        )
+        try:
+            return self._run_traced(
+                raster, plan=plan, band=band, run_dir=run_dir,
+                snapshot_every=snapshot_every, start_tile=start_tile,
+                acc0=acc0, resumed_from=resumed_from,
+                watchdog_default_s=watchdog_default_s,
+                retry_policy=retry_policy, root=root,
+            )
+        except BaseException as e:  # noqa: BLE001 — stamped, re-raised
+            root.set(error=type(e).__name__)
+            raise
+        finally:
+            root.end()
+
+    def _run_traced(
+        self, raster, *, plan, band, run_dir, snapshot_every,
+        start_tile, acc0, resumed_from, watchdog_default_s,
+        retry_policy, root,
+    ) -> RasterScanResult:
+        tiles, zonal = _zonal()
+        th, tw = plan.shape
+        g = self.num_zones
+        eng = self.engine
+        vals, mask = tiles.stack_tiles(
+            raster, plan, band, dtype=np.float64
+        )
+        if acc0 is None:
+            cnt_acc = np.zeros(g, np.int64)
+            sum_acc = np.zeros(g, np.float64)
+            min_acc = np.full(g, np.inf)
+            max_acc = np.full(g, -np.inf)
+        else:
+            cnt_acc = np.asarray(acc0["count"], np.int64).copy()
+            sum_acc = np.asarray(acc0["sum"], np.float64).copy()
+            min_acc = np.asarray(acc0["min"], np.float64).copy()
+            max_acc = np.asarray(acc0["max"], np.float64).copy()
+        meta = None
+        if run_dir is not None:
+            meta = {
+                "ntiles": plan.ntiles,
+                "tile": [th, tw],
+                "band": int(band),
+                "num_zones": g,
+                "snapshot_every": int(snapshot_every),
+                "raster_sha256": _checkpoint.fingerprint(
+                    np.ascontiguousarray(raster.data)
+                ),
+                "trace": root.context.as_dict(),
+            }
+        host = getattr(self.chip_index, "host", None)
+        degraded_tiles = 0
+        snapshots = 0
+        step = int(start_tile)
+        t0 = time.perf_counter()
+        while step < plan.ntiles:
+            seg_n = min(snapshot_every, plan.ntiles - step)
+            with _trace.span("raster.zonal", step=step, n=seg_n):
+                # fault plans trip inside the guard (the watchdog runs
+                # maybe_fail under the retry wrapper): transient errors
+                # retry/degrade, non-transient ones abort the run
+                for t in range(step, step + seg_n):
+
+                    def dispatch(t=t):
+                        # probe + epsilon-band host patch + fold; the
+                        # numpy returns force completion (what a real
+                        # stall would block on)
+                        return eng._tile_zone_stats(
+                            plan, t, vals[t].reshape(-1),
+                            mask[t].reshape(-1),
+                        )
+
+                    try:
+                        cnt, s, mn, mx = call_with_retry(
+                            lambda: _watchdog.guard(
+                                "raster.zonal", dispatch,
+                                default_s=watchdog_default_s,
+                            ),
+                            policy=retry_policy,
+                            label="raster.zonal",
+                        )
+                    except RetryExhausted as e:
+                        if host is None:
+                            raise
+                        _telemetry.record(
+                            "degraded", label="raster.zonal", step=t,
+                            attempts=e.attempts,
+                            error=repr(e.last)[:200],
+                        )
+                        cnt, s, mn, mx = zonal.host_zone_partial(
+                            zonal.host_tile_centers(plan, t),
+                            vals[t].reshape(-1), mask[t].reshape(-1),
+                            host, self.index_system, self.resolution, g,
+                        )
+                        degraded_tiles += 1
+                    cnt = np.asarray(cnt, np.int64)
+                    live = cnt > 0
+                    cnt_acc += cnt
+                    sum_acc = sum_acc + np.asarray(s, np.float64)
+                    mn = np.asarray(mn, np.float64)
+                    mx = np.asarray(mx, np.float64)
+                    min_acc[live] = np.minimum(min_acc[live], mn[live])
+                    max_acc[live] = np.maximum(max_acc[live], mx[live])
+            step += seg_n
+            if run_dir is not None:
+                payload = {
+                    "count": cnt_acc, "sum": sum_acc,
+                    "min": min_acc, "max": max_acc,
+                }
+                try:
+                    _checkpoint.save_snapshot(
+                        run_dir, step, payload, meta
+                    )
+                    snapshots += 1
+                except Exception as e:  # lint: broad-except-ok (durability degrades — coarser resume point — but a sick disk must not kill the scan)
+                    _telemetry.record(
+                        "snapshot_skipped", run_dir=run_dir, step=step,
+                        error=repr(e)[:200],
+                    )
+        wall = time.perf_counter() - t0
+        n_run = plan.ntiles - int(start_tile)
+        px_run = n_run * th * tw
+        _telemetry.record(
+            "raster_stage", stage="scan",
+            seconds=round(wall, 6), ntiles=plan.ntiles,
+            th=th, tw=tw, zones=g, snapshots=snapshots,
+            degraded_tiles=degraded_tiles, resumed_from=resumed_from,
+            pixels_per_sec=round(px_run / max(wall, 1e-9), 1),
+        )
+        live = cnt_acc > 0
+        stats = zonal.ZonalResult(
+            keys=np.nonzero(live)[0].astype(np.int64),
+            count=cnt_acc[live],
+            sum=sum_acc[live],
+            min=min_acc[live],
+            max=max_acc[live],
+            band=band,
+            pixels=int(cnt_acc.sum()),
+        )
+        return RasterScanResult(
+            stats=stats,
+            ntiles=plan.ntiles,
+            pixels=plan.pixels,
+            wall_s=wall,
+            pixels_per_sec=px_run / max(wall, 1e-9),
+            metrics={
+                "degraded": degraded_tiles > 0,
+                "degraded_tiles": degraded_tiles,
+                "snapshots": snapshots,
+                "resumed_from": resumed_from,
+                "run_dir": run_dir,
+            },
+        )
+

@@ -1,0 +1,222 @@
+"""Synthetic MODIS-like GeoTIFF fixture writer.
+
+The real MODIS tile the reference ships
+(``MCD43A4...B01.TIF``: 2400x2400 int16, tiled + deflate + horizontal
+predictor 2, sinusoidal ~463.31 m pixels, nodata 32767, mostly ocean)
+lives in ``/root/reference``, which most environments don't have. This
+module writes a file with the SAME on-disk shape — tiled layout (tags
+322-325), zlib deflate (compression 8), predictor 2 (tag 317), int16,
+band-sequential planes (planar 2), GDAL nodata + metadata tags — so the
+MODIS decode tests exercise the native engine's tiled/compressed/
+predicted path for real instead of xfailing, and fall through to the
+reference file when it is present.
+
+The pixel field is "sinusoidal-ish": an elliptical land blob of smooth
+non-negative reflectance values in an ocean of nodata, tuned so the
+valid fraction lands in the (0.05, 0.2) window the decode test asserts.
+Deliberately NOT written through `raster/core.py`'s writer (which emits
+uncompressed strips): a fixture produced by the code under test would
+prove nothing about the decoder's compressed lanes.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+
+#: MODIS sinusoidal pixel pitch (meters) — the decode test asserts
+#: gt[1] to 1e-3, so the fixture uses the real constant
+MODIS_PIXEL = 463.3127165279165
+
+#: upper-left corner of sinusoidal tile h10v07 (meters)
+MODIS_UL = (-7783653.637667, 2223901.039333)
+
+
+def modis_like_field(
+    width: int = 2400, height: int = 2400, bands: int = 1,
+    nodata: int = 32767, seed: int = 7,
+) -> np.ndarray:
+    """(bands, H, W) int16: smooth non-negative "reflectance" inside an
+    elliptical blob (~10% of pixels), ``nodata`` ocean elsewhere."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:height, 0:width]
+    cy, cx = height * 0.62, width * 0.31
+    # ellipse sized for ~10% coverage: pi*a*b = 0.10*H*W
+    a, b = width * 0.26, height * 0.125
+    inside = ((xx - cx) / a) ** 2 + ((yy - cy) / b) ** 2 < 1.0
+    out = np.full((bands, height, width), nodata, dtype=np.int16)
+    for bi in range(bands):
+        phase = rng.uniform(0, 2 * np.pi)
+        field = (
+            2000.0
+            + 1500.0 * np.sin(xx / width * 9.0 + phase)
+            * np.cos(yy / height * 7.0)
+            + 800.0 * np.cos((xx + 2 * yy) / width * 5.0)
+        )
+        vals = np.clip(field, 0, 32000).astype(np.int16)
+        out[bi][inside] = vals[inside]
+    return out
+
+
+def write_tiled_geotiff(
+    path: str,
+    data: np.ndarray,
+    *,
+    gt=None,
+    nodata: "float | None" = None,
+    meta_xml: str = "",
+    tile: int = 256,
+) -> None:
+    """Write (bands, H, W) int16/uint16 as a tiled + deflate +
+    predictor-2 little-endian classic TIFF, planar configuration 2
+    (plane-major tile order), edge tiles padded to full size — the
+    MODIS on-disk shape."""
+    data = np.ascontiguousarray(data)
+    if data.dtype not in (np.dtype(np.int16), np.dtype(np.uint16)):
+        raise ValueError(
+            f"fixture writer is int16/uint16-only, got {data.dtype}"
+        )
+    bands, h, w = data.shape
+    fmt = 2 if data.dtype == np.dtype(np.int16) else 1
+    ta = -(-w // tile)
+    td = -(-h // tile)
+    if gt is None:
+        gt = (
+            MODIS_UL[0], MODIS_PIXEL, 0.0,
+            MODIS_UL[1], 0.0, -MODIS_PIXEL,
+        )
+    x0, sx, rx, y0, ry, sy = gt
+
+    blobs: list[bytes] = []
+    for bi in range(bands):  # plane-major: all of band 0's tiles first
+        plane = data[bi]
+        for ty in range(td):
+            for tx in range(ta):
+                chunk = np.zeros((tile, tile), data.dtype)
+                sub = plane[
+                    ty * tile : min((ty + 1) * tile, h),
+                    tx * tile : min((tx + 1) * tile, w),
+                ]
+                chunk[: sub.shape[0], : sub.shape[1]] = sub
+                # horizontal differencing (predictor 2), per tile row,
+                # int16 wraparound — the decoder re-integrates per row
+                diffed = chunk.copy()
+                diffed[:, 1:] = chunk[:, 1:] - chunk[:, :-1]
+                blobs.append(
+                    zlib.compress(diffed.astype("<" + data.dtype.str[1:]).tobytes(), 6)
+                )
+
+    entries: list[tuple[int, int, int, bytes]] = []
+
+    def e_short(tag, *vals):
+        entries.append(
+            (tag, 3, len(vals), struct.pack(f"<{len(vals)}H", *vals))
+        )
+
+    def e_long(tag, *vals):
+        entries.append(
+            (tag, 4, len(vals), struct.pack(f"<{len(vals)}I", *vals))
+        )
+
+    def e_dbl(tag, *vals):
+        entries.append(
+            (tag, 12, len(vals), struct.pack(f"<{len(vals)}d", *vals))
+        )
+
+    def e_ascii(tag, s):
+        b = s.encode() + b"\0"
+        entries.append((tag, 2, len(b), b))
+
+    e_long(256, w)
+    e_long(257, h)
+    e_short(258, *([16] * bands))
+    e_short(259, 8)  # Adobe deflate (zlib)
+    e_short(262, 1)
+    e_short(277, bands)
+    e_short(284, 2)  # planar: band-sequential tile planes
+    e_short(317, 2)  # horizontal differencing
+    e_long(322, tile)
+    e_long(323, tile)
+    e_long(324, *([0] * len(blobs)))  # patched after layout
+    e_long(325, *[len(b) for b in blobs])
+    e_short(339, *([fmt] * bands))
+    e_dbl(33550, sx, -sy, 0.0)
+    e_dbl(33922, 0.0, 0.0, 0.0, x0, y0, 0.0)
+    if nodata is not None:
+        e_ascii(42113, repr(float(nodata)))
+    if meta_xml:
+        e_ascii(42112, meta_xml)
+
+    entries.sort(key=lambda t: t[0])
+    n = len(entries)
+    ifd_off = 8
+    val_off = ifd_off + 2 + 12 * n + 4
+    fixed = []
+    out_blobs = []
+    for tag, typ, cnt, val in entries:
+        if len(val) <= 4:
+            fixed.append((tag, typ, cnt, val.ljust(4, b"\0"), None))
+        else:
+            fixed.append((tag, typ, cnt, None, val_off))
+            out_blobs.append((tag, val))
+            val_off += len(val) + (len(val) & 1)
+    data_off = val_off
+    # tile payload layout, then patch the offsets array (tag 324)
+    offs = []
+    cursor = data_off
+    for b in blobs:
+        offs.append(cursor)
+        cursor += len(b) + (len(b) & 1)
+    for i, (tag, val) in enumerate(out_blobs):
+        if tag == 324:
+            out_blobs[i] = (tag, struct.pack(f"<{len(offs)}I", *offs))
+    out = bytearray()
+    out += b"II*\0" + struct.pack("<I", ifd_off)
+    out += struct.pack("<H", n)
+    for tag, typ, cnt, inline, off in fixed:
+        out += struct.pack("<HHI", tag, typ, cnt)
+        if inline is not None:
+            if tag == 324 and cnt == 1:
+                out += struct.pack("<I", offs[0])
+            else:
+                out += inline
+        else:
+            out += struct.pack("<I", off)
+    out += struct.pack("<I", 0)
+    for _tag, val in out_blobs:
+        out += val
+        if len(val) & 1:
+            out += b"\0"
+    for b in blobs:
+        out += b
+        if len(b) & 1:
+            out += b"\0"
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+
+def write_modis_like(
+    path: str,
+    *,
+    width: int = 2400,
+    height: int = 2400,
+    bands: int = 1,
+    nodata: int = 32767,
+    tile: int = 256,
+    seed: int = 7,
+) -> str:
+    """Write the full MODIS-like fixture (field + tags + metadata XML
+    with a dataset-level ``_FillValue``) and return ``path``."""
+    data = modis_like_field(width, height, bands, nodata, seed)
+    meta = (
+        "<GDALMetadata>\n"
+        f'  <Item name="_FillValue">{nodata}</Item>\n'
+        '  <Item name="PRODUCT">SYNTHETIC_MCD43A4</Item>\n'
+        "</GDALMetadata>"
+    )
+    write_tiled_geotiff(
+        path, data, nodata=float(nodata), meta_xml=meta, tile=tile
+    )
+    return path

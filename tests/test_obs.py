@@ -550,6 +550,37 @@ class TestPerfGate:
             assert not ok, (stage, verdicts)
             assert verdicts[f"bench_stage.{stage}"]["status"] == "SLOW"
 
+    def test_trail_pools_isolate_odds(self, tmp_path, monkeypatch,
+                                      capsys):
+        """Each --trail is its own odds pool: a huge unrelated bench in
+        another trail must not dilute a small stage's odds below the
+        point where a 10x slowdown can escape odds_floor."""
+        import perf_gate
+
+        small = _mk_trail(tmp_path, "small.jsonl", {
+            "light": (0.02, 3), "heavy": (0.04, 3),
+        })
+        # 1000x the small trail's total: pooled odds would sink
+        # heavy to ~0.0007, where 10x stays under 3*odds + 0.02
+        huge = _mk_trail(tmp_path, "huge.jsonl", {"compile": (60.0, 1)})
+        golden = str(tmp_path / "golden.json")
+        monkeypatch.setattr(sys, "argv", [
+            "perf_gate.py", "--update", "--golden", golden,
+            "--trail", small, "--trail", huge,
+        ])
+        assert perf_gate.main() == 0
+        capsys.readouterr()
+        monkeypatch.setattr(sys, "argv", [
+            "perf_gate.py", "--golden", golden,
+            "--trail", small, "--trail", huge,
+            "--inject-slowdown", "bench_stage.heavy:10",
+        ])
+        assert perf_gate.main() == 1
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["stages"]["bench_stage.heavy"]["status"] == "SLOW"
+        # and the huge trail's own stage still gates green
+        assert out["stages"]["bench_stage.compile"]["ok"] is True
+
     def test_missing_required_stage_is_red(self, tmp_path):
         import perf_gate
 
@@ -607,7 +638,7 @@ class TestPerfGate:
             assert key.split(".")[0] in (
                 "serve_stage", "stream_stage", "serve_request",
                 "recheck_narrow", "quarantine_stage", "snapshot_saved",
-                "probe_stage",
+                "probe_stage", "raster_stage",
             ), key
 
 

@@ -22,6 +22,20 @@ MODIS = (
 )
 
 
+@pytest.fixture(scope="session")
+def modis_path(tmp_path_factory):
+    """Path to a MODIS GeoTIFF: the real reference tile when the
+    checkout is present, else a synthetic twin with the same on-disk
+    shape (tiled + deflate + predictor-2 int16, planar-2, 463.31 m
+    sinusoidal pixels, 32767 nodata) written by tests/modis_fixture.py."""
+    if os.path.exists(MODIS):
+        return MODIS
+    from tests.modis_fixture import write_modis_like
+
+    p = tmp_path_factory.mktemp("modis") / "synthetic_modis_b01.tif"
+    return write_modis_like(str(p))
+
+
 def _toy_raster(bands=2, h=10, w=12, dtype=np.float32, nodata=-9.0):
     rng = np.random.default_rng(7)
     data = rng.uniform(0, 100, (bands, h, w)).astype(dtype)
@@ -55,19 +69,8 @@ def test_roundtrip_dtypes(tmp_path, dtype):
     assert back.data.dtype == dtype
 
 
-#: the real MODIS tile ships with the reference checkout; without it the
-#: decode tests cannot run (PR 3 triage: environment gap, not a bug)
-_NEEDS_MODIS = pytest.mark.xfail(
-    condition=not os.path.exists(MODIS),
-    reason="reference MODIS GeoTIFF not present in this environment "
-    "(/root/reference checkout missing)",
-    strict=False,
-)
-
-
-@_NEEDS_MODIS
-def test_modis_decode():
-    r = read_raster(MODIS)
+def test_modis_decode(modis_path):
+    r = read_raster(modis_path)
     assert (r.width, r.height, r.num_bands) == (2400, 2400, 1)
     assert r.data.dtype == np.int16
     # MODIS sinusoidal 463.3127m pixels
@@ -165,9 +168,8 @@ def test_checkpoint_save(tmp_path):
     np.testing.assert_array_equal(back.data, r.data)
 
 
-@_NEEDS_MODIS
-def test_reader_registry_gdal_and_grid():
-    meta = read("gdal").load(MODIS)
+def test_reader_registry_gdal_and_grid(modis_path):
+    meta = read("gdal").load(modis_path)
     assert meta[0]["xSize"] == 2400 and meta[0]["bandCount"] == 1
     idx = H3IndexSystem()
     # MODIS srid is user-defined (32767) -> treat coordinates as-is would be

@@ -1,0 +1,234 @@
+"""Tile pipeline + GeoTIFF round-trip edge cases.
+
+Covers the raster engine's staging layer: every `_DTYPES` entry through
+the writer/reader pair, NaN nodata masking, multi-band band-sequential
+layout, ladder snapping with pad+mask for non-divisible shapes, the
+device/host pixel-center parity that the zonal oracles depend on, the
+``MOSAIC_RASTER_TILE`` knob, and the typed decode-error surface.
+"""
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.raster import (
+    Raster,
+    plan_tiles,
+    read_raster,
+    stack_tiles,
+    tile_centers,
+    write_geotiff,
+)
+from mosaic_tpu.raster import tiles as tiles_mod
+from mosaic_tpu.raster import zonal as zonal_mod
+from mosaic_tpu.runtime.errors import RasterDecodeError, is_transient
+
+
+def _mk(bands=1, h=10, w=12, dtype=np.float32, nodata=-9.0, seed=3):
+    rng = np.random.default_rng(seed)
+    data = rng.uniform(1, 100, (bands, h, w)).astype(dtype)
+    return Raster(
+        data=data,
+        gt=(-74.05, 0.01, 0.0, 40.78, 0.0, -0.01),
+        srid=4326,
+        nodata=nodata,
+    )
+
+
+# ---------------------------------------------------------------- round-trip
+
+
+@pytest.mark.parametrize(
+    "dtype",
+    [np.uint8, np.uint16, np.uint32, np.int8, np.int16, np.int32,
+     np.float32, np.float64],
+)
+def test_roundtrip_every_dtype(tmp_path, dtype):
+    # full _DTYPES coverage (test_raster.py samples 5 of the 8)
+    r = _mk(dtype=dtype, nodata=None)
+    p = tmp_path / "t.tif"
+    write_geotiff(str(p), r)
+    back = read_raster(str(p))
+    assert back.data.dtype == np.dtype(dtype)
+    np.testing.assert_array_equal(back.data, r.data)
+
+
+def test_roundtrip_nan_nodata(tmp_path):
+    r = _mk(dtype=np.float32, nodata=np.nan)
+    r.data[0, 2:5, 3:7] = np.nan
+    p = tmp_path / "nan.tif"
+    write_geotiff(str(p), r)
+    back = read_raster(str(p))
+    assert np.isnan(back.nodata)
+    m = back.band(1).mask
+    # v != NaN is vacuously True — the mask must come from isnan
+    assert not m[2, 3] and m[0, 0]
+    assert m.sum() == r.data.size - 12
+    np.testing.assert_array_equal(
+        back.data[0][m], r.data[0][~np.isnan(r.data[0])]
+    )
+
+
+def test_roundtrip_multiband_band_sequential(tmp_path):
+    r = _mk(bands=4, h=17, w=23, dtype=np.int32, nodata=None)
+    p = tmp_path / "mb.tif"
+    write_geotiff(str(p), r)
+    back = read_raster(str(p))
+    assert back.num_bands == 4
+    # planar config 2: any interleave bug scrambles bands, not pixels
+    np.testing.assert_array_equal(back.data, r.data)
+
+
+# ------------------------------------------------------------------- planning
+
+
+def test_plan_snaps_to_ladder():
+    r = _mk(h=75, w=90)
+    plan = plan_tiles(r, (33, 100))
+    # ladder is 32,64,128,...: 33 -> 64, 100 -> 128
+    assert plan.shape == (64, 128)
+    assert plan.requested == (33, 100)
+    assert plan.ntiles == 2 * 1
+    assert plan.pixels == 75 * 90
+    assert plan.padded_pixels == 2 * 64 * 128
+
+
+def test_plan_origin_order_row_major():
+    r = _mk(h=70, w=70)
+    plan = plan_tiles(r, (32, 32))
+    assert plan.shape == (32, 32) and plan.ntiles == 3 * 3
+    expect = [
+        (y, x) for y in (0, 32, 64) for x in (0, 32, 64)
+    ]
+    np.testing.assert_array_equal(plan.origins, np.array(expect))
+
+
+def test_stack_tiles_pad_and_mask():
+    # 75x90 with 32x32 tiles: both axes non-divisible -> edge padding
+    r = _mk(h=75, w=90, nodata=-9.0)
+    r.data[0, :3, :4] = -9.0
+    plan = plan_tiles(r, (32, 32))
+    vals, mask = stack_tiles(r, plan)
+    assert vals.shape == mask.shape == (plan.ntiles, 32, 32)
+    # total valid == in-bounds minus nodata
+    assert mask.sum() == 75 * 90 - 12
+    # pad region of the last tile (origin (64, 64)) is masked out
+    last = plan.ntiles - 1
+    assert not mask[last, 75 - 64 :, :].any()
+    assert not mask[last, :, 90 - 64 :].any()
+    # masked-out values are zeroed (keeps NaN/nodata out of folds)
+    assert (vals[~mask] == 0).all()
+    # reassembly: every valid pixel round-trips exactly
+    recon = np.zeros((75, 90))
+    got = np.zeros((75, 90), dtype=bool)
+    for i, (y0, x0) in enumerate(plan.origins):
+        sub = vals[i][mask[i]]
+        yy, xx = np.nonzero(mask[i])
+        recon[y0 + yy, x0 + xx] = sub
+        got[y0 + yy, x0 + xx] = True
+    band = r.band(1)
+    np.testing.assert_array_equal(got, band.mask)
+    np.testing.assert_array_equal(recon[got], band.values[band.mask])
+
+
+def test_stack_tiles_nan_nodata_zeroed():
+    r = _mk(dtype=np.float64, nodata=np.nan)
+    r.data[0, 1, 1] = np.nan
+    plan = plan_tiles(r, (32, 32))
+    vals, mask = stack_tiles(r, plan)
+    assert not np.isnan(vals).any()
+    assert not mask[0, 1, 1]
+
+
+# ----------------------------------------------------------- center parity
+
+
+def test_tile_centers_device_host_bit_identical():
+    r = _mk(h=75, w=90)
+    r.gt = (100.0, 2.0, 0.5, 50.0, -0.25, -3.0)  # skewed: exercises rx/ry
+    plan = plan_tiles(r, (32, 32))
+    for t in range(plan.ntiles):
+        dev = np.asarray(
+            tile_centers(
+                np.asarray(plan.gt), plan.origins[t],
+                th=plan.shape[0], tw=plan.shape[1],
+            )
+        )
+        host = zonal_mod.host_tile_centers(plan, t)
+        # bit-identical, not approx: the zonal oracle contract depends
+        # on device and host agreeing on the affine evaluation exactly
+        np.testing.assert_array_equal(dev, host)
+
+
+def test_tile_centers_match_raster_to_world():
+    r = _mk(h=40, w=40)
+    plan = plan_tiles(r, (32, 32))
+    dev = np.asarray(
+        tile_centers(np.asarray(plan.gt), plan.origins[3], th=32, tw=32)
+    )
+    # origin (32, 32), first center = pixel (col 32.5, row 32.5)
+    wx, wy = r.raster_to_world(32.5, 32.5)
+    np.testing.assert_allclose(dev[0], [wx, wy], rtol=0, atol=0)
+
+
+# -------------------------------------------------------------------- knob
+
+
+def test_tile_knob(monkeypatch):
+    monkeypatch.delenv("MOSAIC_RASTER_TILE", raising=False)
+    assert tiles_mod.default_tile_shape() == tiles_mod.DEFAULT_TILE
+    monkeypatch.setenv("MOSAIC_RASTER_TILE", "512x128")
+    assert tiles_mod.default_tile_shape() == (512, 128)
+    r = _mk(h=75, w=90)
+    assert plan_tiles(r).shape == (512, 128)
+    monkeypatch.setenv("MOSAIC_RASTER_TILE", "banana")
+    with pytest.raises(ValueError, match="MOSAIC_RASTER_TILE"):
+        tiles_mod.default_tile_shape()
+    monkeypatch.setenv("MOSAIC_RASTER_TILE", "0x64")
+    with pytest.raises(ValueError, match="MOSAIC_RASTER_TILE"):
+        tiles_mod.default_tile_shape()
+
+
+# ------------------------------------------------------------ decode errors
+
+
+def test_decode_error_not_a_tiff(tmp_path):
+    p = tmp_path / "junk.tif"
+    p.write_bytes(b"this is not a tiff at all, sorry")
+    with pytest.raises(RasterDecodeError) as ei:
+        read_raster(str(p))
+    err = ei.value
+    assert err.rc == -2 and err.path == str(p)
+    assert "not a TIFF" in str(err)
+    assert f"native rc {err.rc}" in str(err)
+
+
+def test_decode_error_missing_file(tmp_path):
+    p = str(tmp_path / "nope.tif")
+    with pytest.raises(RasterDecodeError) as ei:
+        read_raster(p)
+    assert ei.value.rc == -10  # fopen failure
+
+
+def test_decode_error_truncated(tmp_path):
+    # valid header, then cut the file mid-IFD
+    src = tmp_path / "ok.tif"
+    write_geotiff(str(src), _mk(nodata=None))
+    raw = src.read_bytes()
+    cut = tmp_path / "cut.tif"
+    cut.write_bytes(raw[: len(raw) // 2])
+    with pytest.raises(RasterDecodeError) as ei:
+        read_raster(str(cut))
+    assert ei.value.rc < 0
+
+
+def test_decode_error_never_transient(tmp_path):
+    # a corrupt file stays corrupt: retry loops must not spin on it,
+    # even when the native message happens to contain a transient marker
+    p = tmp_path / "junk.tif"
+    p.write_bytes(b"MM garbage")
+    with pytest.raises(RasterDecodeError) as ei:
+        read_raster(str(p))
+    assert not is_transient(ei.value)
+    assert not is_transient(
+        RasterDecodeError("decode timeout mid-read", path="x", rc=-11)
+    )

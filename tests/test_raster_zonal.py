@@ -1,0 +1,407 @@
+"""Zonal statistics: bit-identity against the f64 host oracles.
+
+The contract under test (ISSUE 10): every zonal fold — grid cells,
+vector zones, both kernel lanes, and the durable scan through any
+kill/resume point — is bit-identical to a pure-host f64 oracle that
+mirrors the tile decomposition, on adversarial fixtures: NaN nodata,
+zone edges crossing tile boundaries, pixel centers landing EXACTLY on
+zone edges, pad tiles from non-divisible shapes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from mosaic_tpu.core.geometry import wkt
+from mosaic_tpu.core.index import CustomIndexSystem, GridConf
+from mosaic_tpu.core.tessellate import tessellate
+from mosaic_tpu.kernels.pip import TilingError
+from mosaic_tpu.kernels.zonal import zonal_fold, zonal_tiled
+from mosaic_tpu.raster import Raster
+from mosaic_tpu.raster.zonal import (
+    ZonalEngine,
+    host_zonal_grid_oracle,
+    host_zonal_zones_oracle,
+    resolve_zonal_lane,
+    zonal_grid,
+    zonal_zones,
+)
+from mosaic_tpu.runtime import checkpoint, faults, telemetry
+from mosaic_tpu.runtime.retry import RetryPolicy
+from mosaic_tpu.sql import RasterStream
+from mosaic_tpu.sql.join import build_chip_index
+
+CUSTOM = CustomIndexSystem(GridConf(-180, 180, -90, 90, 2, 10.0, 10.0))
+RES = 3
+
+#: zone edges cross the (32, 32) tile boundaries (x = 32/64, rows
+#: 32/64), and the vertical x=6 / horizontal y=8 edges run EXACTLY
+#: through pixel centers of the fixture raster (centers at integer
+#: coordinates); zone 0 carries a hole
+ZONES = [
+    "POLYGON ((6 -20, 50 -25, 70 10, 40 8, 6 8, 6 -20), "
+    "(20 -10, 30 -10, 30 -2, 20 -2, 20 -10))",
+    "POLYGON ((55 -50, 85 -50, 85 -20, 70 -35, 55 -20, 55 -50))",
+    "POLYGON ((2 -55, 20 -55, 20 -40, 2 -40, 2 -55))",
+]
+
+FAST = RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+
+
+@pytest.fixture(scope="module")
+def index():
+    col = wkt.from_wkt(ZONES)
+    return build_chip_index(
+        tessellate(col, CUSTOM, RES, keep_core_geoms=False)
+    )
+
+
+def _mk_raster(h=75, w=90, nodata=-9.0, seed=5, integer=False):
+    """75x90 @ (32,32) tiles -> 3x3 grid, both axes padded; pixel
+    centers at integer world coordinates (x = col, y = 15 - row)."""
+    rng = np.random.default_rng(seed)
+    if integer:
+        data = rng.integers(0, 100, (1, h, w)).astype(np.float64)
+    else:
+        data = rng.uniform(0, 100, (1, h, w))
+    speck = rng.random((h, w)) < 0.1
+    if nodata is not None:
+        data[0][speck] = nodata
+    return Raster(
+        data=data,
+        gt=(-0.5, 1.0, 0.0, 15.5, 0.0, -1.0),
+        srid=0,
+        nodata=nodata,
+    )
+
+
+def _assert_result_equal(got, want):
+    np.testing.assert_array_equal(got.keys, want.keys)
+    np.testing.assert_array_equal(got.count, want.count)
+    np.testing.assert_array_equal(got.sum, want.sum)  # bitwise: f64 fold
+    np.testing.assert_array_equal(got.min, want.min)
+    np.testing.assert_array_equal(got.max, want.max)
+    assert got.pixels == want.pixels
+
+
+# ------------------------------------------------------------------ kernels
+
+
+def test_zonal_fold_matches_sequential_numpy():
+    rng = np.random.default_rng(0)
+    vals = rng.uniform(-50, 50, 4096)
+    seg = rng.integers(-1, 37, 4096).astype(np.int32)
+    cnt, s, mn, mx = (
+        np.asarray(a) for a in zonal_fold(vals, seg, 37)
+    )
+    want_c = np.zeros(37, np.int64)
+    want_s = np.zeros(37)
+    want_mn = np.full(37, np.inf)
+    want_mx = np.full(37, -np.inf)
+    for g, v in zip(seg, vals):  # sequential: the fold's order contract
+        if g >= 0:
+            want_c[g] += 1
+            want_s[g] += v
+            want_mn[g] = min(want_mn[g], v)
+            want_mx[g] = max(want_mx[g], v)
+    np.testing.assert_array_equal(cnt, want_c)
+    np.testing.assert_array_equal(s, want_s)
+    live = want_c > 0
+    np.testing.assert_array_equal(mn[live], want_mn[live])
+    np.testing.assert_array_equal(mx[live], want_mx[live])
+
+
+def test_zonal_tiled_matches_fold_on_exact_summable():
+    rng = np.random.default_rng(1)
+    vals = rng.integers(0, 100, 5000).astype(np.float32)
+    seg = rng.integers(-1, 19, 5000).astype(np.int32)
+    cnt_t, s_t, mn_t, mx_t = (
+        np.asarray(a)
+        for a in zonal_tiled(vals, seg, 19, interpret=True)
+    )
+    cnt_f, s_f, mn_f, mx_f = (
+        np.asarray(a)
+        for a in zonal_fold(
+            vals, seg, 19, acc_dtype=jnp.float32
+        )
+    )
+    np.testing.assert_array_equal(cnt_t, cnt_f)
+    np.testing.assert_array_equal(s_t, s_f)  # integer-valued: exact
+    live = cnt_f > 0
+    np.testing.assert_array_equal(mn_t[live], mn_f[live])
+    np.testing.assert_array_equal(mx_t[live], mx_f[live])
+
+
+def test_zonal_tiled_rejects_bad_tiling():
+    vals = np.zeros(256, np.float32)
+    seg = np.zeros(256, np.int32)
+    with pytest.raises(TilingError):
+        zonal_tiled(vals, seg, 4, tile_n=100, interpret=True)
+    with pytest.raises(TilingError):
+        zonal_tiled(vals, seg, 4, tile_s=64, interpret=True)
+
+
+# --------------------------------------------------------------- lane knob
+
+
+def test_lane_knob(monkeypatch):
+    monkeypatch.delenv("MOSAIC_RASTER_LANE", raising=False)
+    assert resolve_zonal_lane("auto") == "fold"
+    monkeypatch.setenv("MOSAIC_RASTER_LANE", "tiled")
+    assert resolve_zonal_lane("auto") == "tiled"
+    assert resolve_zonal_lane("fold") == "fold"  # explicit beats env
+    monkeypatch.setenv("MOSAIC_RASTER_LANE", "warp")
+    with pytest.raises(ValueError, match="zonal lane"):
+        resolve_zonal_lane("auto")
+
+
+# ------------------------------------------------------------- grid oracle
+
+
+def test_grid_bit_identical_to_oracle():
+    r = _mk_raster()
+    got = zonal_grid(r, RES, index_system=CUSTOM, tile=(32, 32))
+    want = host_zonal_grid_oracle(r, RES, CUSTOM, tile=(32, 32))
+    _assert_result_equal(got, want)
+    # counts cover exactly the valid pixels
+    assert got.pixels == int(r.band(1).mask.sum())
+
+
+def test_grid_oracle_nan_nodata():
+    r = _mk_raster(nodata=np.nan)
+    got = zonal_grid(r, RES, index_system=CUSTOM, tile=(32, 32))
+    want = host_zonal_grid_oracle(r, RES, CUSTOM, tile=(32, 32))
+    _assert_result_equal(got, want)
+    assert np.isfinite(got.sum).all()
+
+
+def test_grid_tile_shape_invariant_keys():
+    # sums are tile-order-dependent (documented), but keys/counts/min/
+    # max are not: any tile shape must agree on those
+    r = _mk_raster()
+    a = zonal_grid(r, RES, index_system=CUSTOM, tile=(32, 32))
+    b = zonal_grid(r, RES, index_system=CUSTOM, tile=(64, 128))
+    np.testing.assert_array_equal(a.keys, b.keys)
+    np.testing.assert_array_equal(a.count, b.count)
+    np.testing.assert_array_equal(a.min, b.min)
+    np.testing.assert_array_equal(a.max, b.max)
+    np.testing.assert_allclose(a.sum, b.sum, rtol=1e-12)
+    # mean/stat view
+    st = a.stat("mean")
+    assert st[int(a.keys[0])] == pytest.approx(a.sum[0] / a.count[0])
+
+
+# ------------------------------------------------------------ zones oracle
+
+
+def test_zones_bit_identical_to_oracle(index):
+    r = _mk_raster()
+    got = zonal_zones(r, index, CUSTOM, RES, tile=(32, 32))
+    want = host_zonal_zones_oracle(r, index, CUSTOM, RES, tile=(32, 32))
+    _assert_result_equal(got, want)
+    assert set(got.keys) <= {0, 1, 2}
+    assert len(got.keys) == 3  # every zone is hit by this fixture
+
+
+def test_zones_oracle_nan_nodata_and_edge_centers(index):
+    # NaN nodata + centers exactly on the x=6 / y=8 zone edges: device
+    # probe and f64 host join must classify every such pixel identically
+    r = _mk_raster(nodata=np.nan, seed=11)
+    got = zonal_zones(r, index, CUSTOM, RES, tile=(32, 32))
+    want = host_zonal_zones_oracle(r, index, CUSTOM, RES, tile=(32, 32))
+    _assert_result_equal(got, want)
+
+
+def test_zones_engine_reuse_and_hole(index):
+    # hole pixels (zone 0's interior ring) fold nowhere: count over the
+    # hole bbox interior must be absent from zone 0's pixels
+    eng = ZonalEngine(CUSTOM, RES, chip_index=index)
+    r = _mk_raster(nodata=None, seed=13)
+    got = eng.zones(r, tile=(32, 32))
+    want = host_zonal_zones_oracle(r, index, CUSTOM, RES, tile=(32, 32))
+    _assert_result_equal(got, want)
+    # engine reuse across rasters (same tile shape -> same executables)
+    r2 = _mk_raster(seed=17)
+    _assert_result_equal(
+        eng.zones(r2, tile=(32, 32)),
+        host_zonal_zones_oracle(r2, index, CUSTOM, RES, tile=(32, 32)),
+    )
+
+
+def test_zones_tiled_lane_agrees_on_integer_data(index):
+    # the f32 Pallas lane holds bit-identity on exact-summable values
+    r = _mk_raster(integer=True, seed=23)
+    fold = ZonalEngine(
+        CUSTOM, RES, chip_index=index, lane="fold"
+    ).zones(r, tile=(32, 32))
+    tiled = ZonalEngine(
+        CUSTOM, RES, chip_index=index, lane="tiled"
+    ).zones(r, tile=(32, 32))
+    np.testing.assert_array_equal(tiled.keys, fold.keys)
+    np.testing.assert_array_equal(tiled.count, fold.count)
+    np.testing.assert_array_equal(tiled.sum, fold.sum)
+    np.testing.assert_array_equal(tiled.min, fold.min)
+    np.testing.assert_array_equal(tiled.max, fold.max)
+
+
+def test_zones_requires_chip_index():
+    eng = ZonalEngine(CUSTOM, RES)
+    with pytest.raises(ValueError, match="chip_index"):
+        eng.zones(_mk_raster())
+
+
+def test_zonal_emits_stage_telemetry(index):
+    with telemetry.capture() as ev:
+        zonal_zones(_mk_raster(), index, CUSTOM, RES, tile=(32, 32))
+    stages = [
+        e.get("stage") for e in ev if e["event"] == "raster_stage"
+    ]
+    assert "tile" in stages and "zonal" in stages
+
+
+# ------------------------------------------------------------ durable scan
+
+
+@pytest.fixture(scope="module")
+def stream(index):
+    return RasterStream(index, CUSTOM, RES)
+
+
+@pytest.fixture(scope="module")
+def raster():
+    return _mk_raster(seed=29)
+
+
+@pytest.fixture(scope="module")
+def clean(stream, raster):
+    return stream.scan(raster, tile=(32, 32))
+
+
+def test_scan_matches_engine_and_oracle(stream, raster, clean, index):
+    want = host_zonal_zones_oracle(
+        raster, index, CUSTOM, RES, tile=(32, 32)
+    )
+    _assert_result_equal(clean.stats, want)
+    assert clean.ntiles == 9
+    assert clean.pixels == 75 * 90
+
+
+def test_durable_scan_equals_plain(stream, raster, clean, tmp_path):
+    r = stream.scan(
+        raster, tile=(32, 32), run_dir=str(tmp_path), snapshot_every=2,
+    )
+    _assert_result_equal(r.stats, clean.stats)
+    # 9 tiles, every-2 boundaries: 2, 4, 6, 8, 9
+    assert r.metrics["snapshots"] == 5
+    assert checkpoint.list_snapshots(str(tmp_path)) == [2, 4, 6, 8, 9]
+
+
+@pytest.mark.parametrize("kill_after", [2, 4, 6])
+def test_scan_kill_and_resume_bit_identical(
+    stream, raster, clean, tmp_path, kill_after
+):
+    """Fatal device loss after ``kill_after`` tiles; resume() from the
+    newest snapshot converges to the clean fold bit for bit."""
+    d = str(tmp_path / f"kill{kill_after}")
+    with faults.inject(
+        fail_first=99, skip_first=kill_after,
+        sites=("raster.zonal",),
+        exc_factory=lambda s: RuntimeError(f"simulated device loss @ {s}"),
+    ):
+        with pytest.raises(RuntimeError, match="simulated device loss"):
+            stream.scan(
+                raster, tile=(32, 32), run_dir=d, snapshot_every=2,
+                retry_policy=FAST,
+            )
+    assert checkpoint.list_snapshots(d)
+    r = stream.resume(d, raster, retry_policy=FAST)
+    _assert_result_equal(r.stats, clean.stats)
+    assert r.metrics["resumed_from"] == kill_after  # boundary == kill pt
+
+
+def test_scan_transient_faults_retry_to_clean(stream, raster, clean, tmp_path):
+    with telemetry.capture() as ev:
+        with faults.transient_errors(2, sites=("raster.zonal",)):
+            r = stream.scan(
+                raster, tile=(32, 32), run_dir=str(tmp_path / "t"),
+                snapshot_every=4, retry_policy=FAST,
+            )
+    _assert_result_equal(r.stats, clean.stats)
+    assert r.metrics["degraded"] is False
+    assert [e["event"] for e in ev].count("transient_retry") == 2
+
+
+def test_scan_exhausted_tile_degrades_to_host(stream, raster, clean, tmp_path):
+    """A tile whose retry budget exhausts is answered by the f64 host
+    twin — bit-identical, so the final fold still equals clean."""
+    with telemetry.capture() as ev:
+        with faults.transient_errors(
+            3, sites=("raster.zonal",)
+        ):  # == FAST.max_attempts: tile 0's budget exhausts
+            r = stream.scan(
+                raster, tile=(32, 32), run_dir=str(tmp_path / "d"),
+                snapshot_every=4, retry_policy=FAST,
+            )
+    assert r.metrics["degraded"] is True
+    assert r.metrics["degraded_tiles"] == 1
+    _assert_result_equal(r.stats, clean.stats)
+    assert "degraded" in [e["event"] for e in ev]
+
+
+def test_scan_snapshot_failure_does_not_kill_run(
+    stream, raster, clean, tmp_path
+):
+    with telemetry.capture() as ev:
+        with faults.transient_errors(999, sites=("raster.snapshot",)):
+            # snapshot site is guarded by save_snapshot itself; simulate
+            # a sick disk instead by pointing run_dir at a file
+            p = tmp_path / "not_a_dir"
+            p.write_text("x")
+            r = stream.scan(
+                raster, tile=(32, 32), run_dir=str(p), snapshot_every=4,
+            )
+    _assert_result_equal(r.stats, clean.stats)
+    assert r.metrics["snapshots"] == 0
+    assert "snapshot_skipped" in [e["event"] for e in ev]
+
+
+def test_resume_rejects_wrong_raster(stream, raster, tmp_path):
+    stream.scan(
+        raster, tile=(32, 32), run_dir=str(tmp_path), snapshot_every=4,
+    )
+    other = _mk_raster(seed=99)
+    with pytest.raises(ValueError, match="fingerprint"):
+        stream.resume(str(tmp_path), other)
+
+
+def test_resume_without_snapshots_raises(stream, raster, tmp_path):
+    with pytest.raises(FileNotFoundError, match="nothing to resume"):
+        stream.resume(str(tmp_path / "empty"), raster)
+
+
+def test_scan_joins_trace_on_resume(stream, raster, tmp_path):
+    d = str(tmp_path)
+    with faults.inject(
+        fail_first=99, skip_first=4, sites=("raster.zonal",),
+        exc_factory=lambda s: RuntimeError("boom"),
+    ):
+        with telemetry.capture() as ev1:
+            with pytest.raises(RuntimeError):
+                stream.scan(
+                    raster, tile=(32, 32), run_dir=d, snapshot_every=2,
+                )
+    with telemetry.capture() as ev2:
+        stream.resume(d, raster)
+
+    def scan_span(evs):
+        return next(
+            e for e in evs
+            if e["event"] == "span" and e["name"] == "raster.scan"
+        )
+
+    first, second = scan_span(ev1), scan_span(ev2)
+    # the resumed run joins the killed run's trace, not a fresh one
+    assert second["trace_id"] == first["trace_id"]
+    assert second["resumed_from"] == 4
+    assert first["error"] == "RuntimeError"

@@ -10,12 +10,16 @@ bench.py) into an enforced contract against a committed golden
 `tools/trace_report.py`: ``stream_stage.join_loop``,
 ``serve_stage.dispatch``, ...) the gate computes the stage's *odds* —
 its total seconds over the total of every OTHER stage in the same
-trail set. Odds are invariant under uniform machine speed (a CI runner
-3x slower than the golden machine scales every stage alike), but a
-regression in ONE stage moves its odds by the regression factor — so
-the tolerance can be modest (default 3x) while a genuine 10x stage
-slowdown still fails loudly on any machine (the negative lane in CI
-injects exactly that via ``--inject-slowdown``).
+trail. Each ``--trail`` is its own odds pool: one bench's wall time
+cannot dilute another bench's odds (pooling across benches would sink
+small stages below the noise floor, where a 10x slowdown can no longer
+escape ``odds_floor``); a stage that appears in several trails gates
+on its worst pool. Odds are invariant under uniform machine speed (a
+CI runner 3x slower than the golden machine scales every stage alike),
+but a regression in ONE stage moves its odds by the regression factor
+— so the tolerance can be modest (default 3x) while a genuine 10x
+stage slowdown still fails loudly on any machine (the negative lane in
+CI injects exactly that via ``--inject-slowdown``).
 
 **Gate rule** per golden stage with recorded odds g: fresh odds must
 satisfy ``odds <= g * tolerance + odds_floor`` (the floor forgives
@@ -54,8 +58,8 @@ SKIP_PREFIXES = ("span.",)
 
 
 def stage_odds(events) -> dict:
-    """``{stage_key: {"seconds", "count", "odds"}}`` over one or more
-    merged trails; odds = seconds / (total - seconds)."""
+    """``{stage_key: {"seconds", "count", "odds"}}`` over ONE trail's
+    events (one odds pool); odds = seconds / (total - seconds)."""
     from trace_report import stage_breakdown
 
     stages = {
@@ -72,6 +76,37 @@ def stage_odds(events) -> dict:
             "count": v["count"],
             "odds": round(v["total_s"] / rest, 6),
         }
+    return out
+
+
+def apply_slowdown(pool: dict, stage: str, factor: float) -> dict:
+    """Scale one stage's seconds within its pool and recompute every
+    odds in that pool (what a real single-stage regression does)."""
+    scaled = {
+        k: dict(v, seconds=v["seconds"] * (factor if k == stage else 1.0))
+        for k, v in pool.items()
+    }
+    total = sum(v["seconds"] for v in scaled.values())
+    for v in scaled.values():
+        rest = max(total - v["seconds"], 1e-9 * max(total, 1e-9))
+        v["odds"] = round(v["seconds"] / rest, 6)
+    return scaled
+
+
+def merge_pools(pools) -> dict:
+    """Union of per-trail pools: seconds/count sum across trails, odds
+    gate on the worst (largest) pool — a stage must be healthy in every
+    bench it appears in."""
+    out: dict = {}
+    for pool in pools:
+        for k, v in pool.items():
+            cur = out.get(k)
+            if cur is None:
+                out[k] = dict(v)
+            else:
+                cur["seconds"] = round(cur["seconds"] + v["seconds"], 6)
+                cur["count"] += v["count"]
+                cur["odds"] = max(cur["odds"], v["odds"])
     return out
 
 
@@ -115,7 +150,8 @@ def evaluate(
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trail", action="append", required=True,
-                    help="trail file (repeatable; trails are merged)")
+                    help="trail file (repeatable; each trail is its "
+                    "own odds pool)")
     ap.add_argument("--golden", default=DEFAULT_GOLDEN)
     ap.add_argument("--update", action="store_true",
                     help="rewrite the golden from these trails")
@@ -129,27 +165,19 @@ def main() -> int:
 
     from mosaic_tpu.obs import export
 
-    events: list = []
-    for path in args.trail:
-        events.extend(export.read_trail(path))
-    fresh = stage_odds(events)
+    pools = [stage_odds(export.read_trail(p)) for p in args.trail]
 
     if args.inject_slowdown:
         stage, factor = args.inject_slowdown.rsplit(":", 1)
-        if stage not in fresh:
+        if not any(stage in pool for pool in pools):
             sys.stderr.write(f"inject-slowdown: no stage {stage!r}\n")
             return 2
-        scaled = {
-            k: dict(v, seconds=v["seconds"] * (
-                float(factor) if k == stage else 1.0
-            ))
-            for k, v in fresh.items()
-        }
-        total = sum(v["seconds"] for v in scaled.values())
-        for k, v in scaled.items():
-            rest = max(total - v["seconds"], 1e-9)
-            v["odds"] = round(v["seconds"] / rest, 6)
-        fresh = scaled
+        pools = [
+            apply_slowdown(pool, stage, float(factor))
+            if stage in pool else pool
+            for pool in pools
+        ]
+    fresh = merge_pools(pools)
 
     if args.update:
         golden = {
