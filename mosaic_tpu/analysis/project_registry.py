@@ -34,7 +34,14 @@ REGISTRY_NOTE = (
 #: library + tool code carries registered names; tests exercise them
 SCAN_TARGETS = ("mosaic_tpu", "tools", "bench.py")
 
-_FAULT_HOOKS = {"maybe_fail", "maybe_corrupt", "planned_stall", "guard"}
+#: call tails whose first literal argument is a fault/watchdog site.
+#: `guarded_call` / `execute_resilient` are the dispatch core's guarded
+#: entry points — frontends name their site there, so the scanner must
+#: read it from the same position it reads `guard`'s.
+_FAULT_HOOKS = {
+    "maybe_fail", "maybe_corrupt", "planned_stall", "guard",
+    "guarded_call", "execute_resilient",
+}
 _KNOB_RE = re.compile(r"^MOSAIC_[A-Z0-9_]+$")
 _KNOB_PREFIX_RE = re.compile(r"^MOSAIC_[A-Z0-9_]*$")
 

@@ -1,0 +1,94 @@
+"""The dispatch core owns execution wiring — frontends adopt it.
+
+ISSUE 11 unified four per-frontend copies of the same discipline
+(compile cache + watchdog + retry + degradation) into
+`mosaic_tpu/dispatch`. This rule keeps the unification from eroding:
+a frontend that re-grows its own `call_with_retry` composition, raw
+`watchdog.guard` call, or module-level compiled-program cache silently
+forks the execution path again — the exact drift the dispatch core
+exists to prevent. `mosaic_tpu/dispatch/` and `mosaic_tpu/runtime/`
+(the implementations being composed) are exempt by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, dotted
+from ..engine import FileContext
+from ..findings import Finding
+from ..registry import rule
+
+#: the only packages allowed to touch the raw wiring
+_OWNERS = ("mosaic_tpu/dispatch/", "mosaic_tpu/runtime/")
+
+_HINT_GUARD = (
+    "route through dispatch.guarded_call(site, fn, ...) (retry=False "
+    "for watchdog-only stages) so the composition exists once"
+)
+_HINT_CACHE = (
+    "register the program cache with @dispatch.bounded_cache(name, "
+    "maxsize) so it lands in dispatch.cache_stats() and stays bounded"
+)
+
+#: call tails that mean "this function traces/compiles a program"
+_PROGRAM_TAILS = ("jit", "shard_map", "pallas_call")
+
+
+def _builds_program(fn_node: ast.AST) -> bool:
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Call):
+            name = call_name(sub)
+            tail = name.split(".")[-1]
+            if tail in _PROGRAM_TAILS:
+                return True
+    return False
+
+
+@rule("dispatch-adoption")
+def dispatch_adoption(ctx: FileContext) -> list[Finding]:
+    """Frontends must not compose their own watchdog/retry wiring or
+    module-level compiled-program caches — that lives in
+    mosaic_tpu/dispatch (guarded_call / bounded_cache)."""
+    if not ctx.in_library or ctx.rel.startswith(_OWNERS):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            tail = name.split(".")[-1]
+            if tail == "call_with_retry":
+                out.append(Finding(
+                    rule="dispatch-adoption", path=ctx.rel,
+                    line=node.lineno,
+                    message="frontend composes its own retry wiring "
+                            "(call_with_retry)",
+                    hint=_HINT_GUARD,
+                ))
+            elif tail == "guard" and "watchdog" in name:
+                out.append(Finding(
+                    rule="dispatch-adoption", path=ctx.rel,
+                    line=node.lineno,
+                    message="frontend calls watchdog.guard directly",
+                    hint=_HINT_GUARD,
+                ))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # an lru_cache-decorated program factory is a private
+            # compile cache — invisible to dispatch.cache_stats()
+            for dec in node.decorator_list:
+                dec_name = (
+                    call_name(dec) if isinstance(dec, ast.Call)
+                    else dotted(dec)
+                )
+                if dec_name.split(".")[-1] in ("lru_cache", "cache") and (
+                    "functools" in dec_name or "." not in dec_name
+                ) and _builds_program(node):
+                    out.append(Finding(
+                        rule="dispatch-adoption", path=ctx.rel,
+                        line=dec.lineno,
+                        message=f"private compiled-program cache "
+                                f"{node.name!r} bypasses the dispatch "
+                                "registry",
+                        hint=_HINT_CACHE,
+                    ))
+    return out

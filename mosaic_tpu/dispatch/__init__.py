@@ -1,0 +1,68 @@
+"""`mosaic_tpu.dispatch` — the unified execution core.
+
+One compile-cache/execution path for every frontend (batch `pip_join`,
+`StreamJoin`, `ServeEngine`, `RasterStream`, `dist_pip_join`): bucketed
+shape discipline, one `(bucket, index, mesh)` compile cache with warmup,
+the watchdog/retry/host-oracle-degradation wiring, and the data-parallel
+sharding hook. See `dispatch/core.py` for the ownership story and
+`docs/ARCHITECTURE.md` ("Dispatch core") for the per-frontend
+delegation table.
+"""
+
+from .bucket import (
+    DEFAULT_MAX_BUCKET,
+    DEFAULT_MIN_BUCKET,
+    BucketLadder,
+    backend_compiles,
+    dispatch_signature,
+    mesh_key,
+)
+from .core import (
+    DispatchCore,
+    bounded_cache,
+    cache_stats,
+    cache_view,
+    cells_prog,
+    clear_caches,
+    core_for,
+    data_mesh,
+    guarded_call,
+    jit_compact,
+    jit_counts,
+    jit_join,
+    join_cache_view,
+    probe_check_rep,
+    register_cache,
+    resolve_mesh,
+    sharded_join_prog,
+    sharded_pointwise,
+    stream_programs,
+)
+
+__all__ = [
+    "BucketLadder",
+    "DEFAULT_MAX_BUCKET",
+    "DEFAULT_MIN_BUCKET",
+    "DispatchCore",
+    "backend_compiles",
+    "bounded_cache",
+    "cache_stats",
+    "cache_view",
+    "cells_prog",
+    "clear_caches",
+    "core_for",
+    "data_mesh",
+    "dispatch_signature",
+    "guarded_call",
+    "jit_compact",
+    "jit_counts",
+    "jit_join",
+    "join_cache_view",
+    "mesh_key",
+    "probe_check_rep",
+    "register_cache",
+    "resolve_mesh",
+    "sharded_join_prog",
+    "sharded_pointwise",
+    "stream_programs",
+]

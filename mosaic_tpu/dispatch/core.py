@@ -1,0 +1,741 @@
+"""The unified dispatch core: one compile-cache/execution path for
+batch, stream, serve, and raster.
+
+Before this package, four frontends (`sql.pip_join`, `sql.StreamJoin`,
+`serve.ServeEngine`, `sql.RasterStream`) plus `parallel.dist_pip_join`
+each wired their own route onto the same execution discipline: a jitted
+probe behind a compile cache, a watchdog deadline, transient retry, and
+f64 host-oracle degradation. The duplication was the scale blocker —
+multichip sharding would have been written four times. This module owns
+the discipline exactly once:
+
+- **Shape discipline** (`.bucket`): the pad-to-bucket ladder and the
+  deterministic `(bucket, index, mesh)` compile signature, lifted from
+  the serving engine and now shared by every frontend.
+- **Compiled programs**: the jitted join/counts/compact executables and
+  the per-(system, resolution) cell-assignment programs, each behind a
+  bounded, registered cache (`bounded_cache`) with one observability
+  surface (:func:`cache_stats` / :func:`clear_caches`).
+- **Resilience**: :func:`guarded_call` composes the watchdog deadline,
+  transient retry, and degradation fallback. Frontends name their fault
+  site and hand over the attempt — none re-implements the wiring.
+- **Placement**: :func:`resolve_mesh` (the ``MOSAIC_MESH`` knob),
+  :func:`sharded_join_prog` and :func:`sharded_pointwise` put the point
+  stream data-parallel over a 1-D ``dp`` mesh with a fully replicated
+  ChipIndex. Per-shard caps keep the full-bucket overflow guarantee, so
+  a sharded dispatch is bit-identical to single-device by construction
+  (every point's result depends only on that point and the replicated
+  index).
+
+:class:`DispatchCore` binds the pieces to one resident index: caps,
+signature accounting, :meth:`~DispatchCore.warmup` precompiling every
+ladder rung, and the guarded execute path. `ServeEngine` delegates to
+it; `pip_join(mesh=...)` routes batches through a process-cached core
+(:func:`core_for`) and thereby inherits the serving path's ~1000×
+steady-state compile discipline.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..obs import trace as _trace
+from ..runtime import telemetry as _telemetry, watchdog as _watchdog
+from ..runtime.retry import call_with_retry
+from .bucket import (
+    BucketLadder,
+    backend_compiles,
+    dispatch_signature,
+    mesh_key,
+)
+
+__all__ = [
+    "DispatchCore",
+    "bounded_cache",
+    "cache_stats",
+    "cache_view",
+    "cells_prog",
+    "clear_caches",
+    "core_for",
+    "data_mesh",
+    "guarded_call",
+    "jit_compact",
+    "jit_counts",
+    "jit_join",
+    "join_cache_view",
+    "probe_check_rep",
+    "register_cache",
+    "resolve_mesh",
+    "sharded_join_prog",
+    "sharded_pointwise",
+    "stream_programs",
+]
+
+
+# ------------------------------------------------------------ resilience
+
+def guarded_call(
+    site: str,
+    fn,
+    *args,
+    default_s=None,
+    policy=None,
+    fallback=None,
+    label=None,
+    classify=None,
+    retry: bool = True,
+    **kwargs,
+):
+    """THE watchdog/retry/degradation composition, written once.
+
+    Runs ``fn(*args, **kwargs)`` under the ``site`` watchdog deadline
+    (per-site ``MOSAIC_WATCHDOG_<SITE>`` beats global ``MOSAIC_WATCHDOG_S``
+    beats ``default_s``; the site doubles as the fault-injection hook),
+    retried on transient failures per ``policy`` (env-tuned
+    ``MOSAIC_RETRY_*`` when None); past the budget it degrades through
+    ``fallback`` (:class:`DegradedResult`) or raises
+    :class:`RetryExhausted`. ``retry=False`` keeps only the watchdog —
+    for stages whose callers own the failure (e.g. ring prefetch).
+
+    Frontends call this instead of composing `runtime.watchdog.guard` +
+    `runtime.retry.call_with_retry` themselves — the lint rule
+    ``dispatch-adoption`` enforces that the wiring exists only here.
+    """
+
+    def attempt():
+        return _watchdog.guard(site, fn, *args, default_s=default_s, **kwargs)
+
+    if not retry:
+        return attempt()
+    kw = {"policy": policy, "fallback": fallback, "label": label or site}
+    if classify is not None:
+        kw["classify"] = classify
+    return call_with_retry(attempt, **kw)
+
+
+# ---------------------------------------------------------- cache registry
+
+#: every compiled-program cache in the process, by name — the single
+#: surface `cache_stats`/`clear_caches` (and the `unbounded-cache` lint
+#: rule) audit. Values are `functools.lru_cache` wrappers or objects
+#: exposing the same `cache_info()`/`cache_clear()` protocol.
+_CACHES: dict = {}
+
+
+def register_cache(name: str, cached_fn):
+    """Register a bounded cache under the unified observability surface.
+    Rejects unbounded caches — an unbounded compiled-program population
+    is exactly the failure mode the bucket ladder exists to prevent."""
+    info = cached_fn.cache_info()
+    if info.maxsize is None:
+        raise ValueError(f"dispatch cache {name!r} must be bounded")
+    _CACHES[name] = cached_fn
+    return cached_fn
+
+
+def bounded_cache(name: str, maxsize: int):
+    """Decorator: ``functools.lru_cache(maxsize)`` + registration. The
+    only sanctioned way for a frontend to memoize compiled programs —
+    the cache lands in :func:`cache_stats` and is bounded by
+    construction."""
+    if maxsize is None:
+        raise ValueError("bounded_cache requires a finite maxsize")
+
+    def deco(fn):
+        return register_cache(name, functools.lru_cache(maxsize=maxsize)(fn))
+
+    return deco
+
+
+def _stats_of(cached_fn) -> dict:
+    i = cached_fn.cache_info()
+    return {
+        "hits": i.hits,
+        "misses": i.misses,
+        "maxsize": i.maxsize,
+        "currsize": i.currsize,
+    }
+
+
+def _jit_cache_size(fn) -> int:
+    try:
+        return int(fn._cache_size())
+    except Exception:  # lint: broad-except-ok (jax version without the introspection hook; -1 means unknown)
+        return -1
+
+
+def _clear_jit(fn) -> None:
+    try:
+        fn.clear_cache()
+    except Exception:  # lint: broad-except-ok (older jax spells it _clear_cache)
+        try:
+            fn._clear_cache()
+        except Exception:  # lint: broad-except-ok (no clear hook on this jax; cache drops at process exit)
+            pass
+
+
+def cache_view(name: str) -> dict:
+    """`{hits, misses, maxsize, currsize}` for one registered cache
+    (zeros if it was never created — nothing is cached yet)."""
+    c = _CACHES.get(name)
+    if c is None:
+        return {"hits": 0, "misses": 0, "maxsize": 0, "currsize": 0}
+    return _stats_of(c)
+
+
+def cache_stats(emit: bool = True) -> dict:
+    """One stats dict over EVERY dispatch-owned cache: per-cache
+    ``{hits, misses, maxsize, currsize}`` plus ``jit_programs`` counting
+    compiled (shape, static-args) specializations of the shared join /
+    counts / compact executables. Replaces the per-frontend
+    ``join_cache_stats`` / ``knn_cache_stats`` trio (kept as thin
+    views). Emits one ``dispatch_cache_stats`` telemetry event
+    (``emit=False`` reads silently) so long-running servers can chart
+    growth and decide when to call :func:`clear_caches`."""
+    stats = {name: _stats_of(c) for name, c in sorted(_CACHES.items())}
+    stats["jit_programs"] = {
+        "join": _jit_cache_size(jit_join()),
+        "counts": _jit_cache_size(jit_counts()),
+        "compact": _jit_cache_size(jit_compact()),
+    }
+    if emit:
+        _telemetry.record("dispatch_cache_stats", **stats)
+    return stats
+
+
+def clear_caches(names=None, emit: bool = True) -> dict:
+    """Release dispatch-owned caches (all of them, or just ``names``);
+    returns the pre-clear :func:`cache_stats`.
+
+    Program caches hold strong references to every index system / mesh
+    they compiled for — harmless for the built-in singletons, but a
+    long-running server cycling many custom grids pins each one for
+    process lifetime. This is the escape hatch: caches regrow on next
+    use (the next call per shape pays one recompile). Emits
+    ``dispatch_caches_cleared`` telemetry."""
+    stats = cache_stats(emit=False)
+    targets = (
+        list(_CACHES.items())
+        if names is None
+        else [(n, _CACHES[n]) for n in names if n in _CACHES]
+    )
+    for name, c in targets:
+        if name in _JIT_FACTORIES and c.cache_info().currsize:
+            _clear_jit(c())
+        c.cache_clear()
+    if emit:
+        _telemetry.record("dispatch_caches_cleared", **stats)
+    return stats
+
+
+# ------------------------------------------------------ compiled programs
+
+@functools.lru_cache(maxsize=1)
+def _join_mod():
+    # deferred: sql.join imports this package at module level, so the
+    # reverse edge must resolve lazily (first program build, by which
+    # point sql.join is fully initialized)
+    from ..sql import join
+
+    return join
+
+
+@bounded_cache("jit_join", 1)
+def jit_join():
+    """The process-wide jitted exact join — ONE executable cache shared
+    by batch, stream, serve, raster, and the sharded step, so a server
+    and a batch job in one process share compiles."""
+    m = _join_mod()
+    return jax.jit(
+        m.pip_join_points,
+        static_argnames=(
+            "heavy_cap", "found_cap", "writeback", "lookup", "compaction",
+            "compact_block", "probe", "convex_cap",
+        ),
+    )
+
+
+@bounded_cache("jit_counts", 1)
+def jit_counts():
+    """Jitted exact-cap probe counts ((3,) found/heavy/convex)."""
+    return jax.jit(_join_mod()._probe_counts)
+
+
+@bounded_cache("jit_compact", 1)
+def jit_compact():
+    """Jitted epsilon-band compaction, one compile per cap bucket."""
+    return jax.jit(_join_mod()._compact, static_argnames=("cap",))
+
+
+#: factories whose cached VALUE is itself a jitted wrapper — clearing
+#: them must also drop the wrapper's compiled programs
+_JIT_FACTORIES = frozenset({"jit_join", "jit_counts", "jit_compact"})
+
+
+@bounded_cache("cells_prog", 64)
+def cells_prog(index_system, resolution: int, variant: str = "cells"):
+    """Cached jitted cell-assignment programs per (system, res, variant).
+
+    The lru key keeps a reference to the index system — idempotent
+    systems (all built-ins) are cheap singletons, so the retention is
+    harmless; :func:`clear_caches` is the escape hatch for servers
+    cycling many custom grids.
+    """
+    if variant == "margin":
+        fn = lambda p: index_system.point_to_cell_margin(p, resolution)  # noqa: E731
+    elif variant == "alt":
+        fn = lambda p: index_system.point_to_cell_alt(p, resolution)  # noqa: E731
+    else:
+        fn = lambda p: index_system.point_to_cell(p, resolution)  # noqa: E731
+    return jax.jit(fn)
+
+
+def join_cache_view() -> dict:
+    """The legacy `sql.join.join_cache_stats` dict shape, served from
+    the unified registry (`{"cells_prog": {...}, "jit_join": n,
+    "jit_compact": n}`)."""
+    return {
+        "cells_prog": cache_view("cells_prog"),
+        "jit_join": _jit_cache_size(jit_join()),
+        "jit_compact": _jit_cache_size(jit_compact()),
+    }
+
+
+@bounded_cache("stream_programs", 16)
+def stream_programs(
+    index_system,
+    resolution: int,
+    *,
+    dtype,
+    cell_dtype,
+    found_cap,
+    heavy_cap,
+    lookup,
+    compaction,
+    probe,
+    convex_cap,
+    prefetch,
+    donate_ring,
+    mesh,
+):
+    """The StreamJoin program bundle (assign/join/step/loop/segment
+    executables) per static spec — two StreamJoins over the same
+    (system, resolution, caps, placement) replay one compiled scan
+    instead of tracing their own."""
+    from ..sql import stream as m
+
+    return m.build_stream_programs(
+        index_system, resolution, dtype=dtype, cell_dtype=cell_dtype,
+        found_cap=found_cap, heavy_cap=heavy_cap, lookup=lookup,
+        compaction=compaction, probe=probe, convex_cap=convex_cap,
+        prefetch=prefetch, donate_ring=donate_ring, mesh=mesh,
+    )
+
+
+# -------------------------------------------------------------- placement
+
+def probe_check_rep(probe: str) -> bool:
+    """shard_map replication checking must be off for lanes whose body
+    contains a `pallas_call` (the heavy/adaptive tiers) — the primitive
+    has no replication rule."""
+    return probe in ("scatter", "adaptive-light", "adaptive-convex")
+
+
+def data_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D ``("dp",)`` data-parallel mesh over the first ``n_devices``
+    devices (all of them by default) — the placement of the sharded
+    dispatch lane: points sharded over ``dp``, ChipIndex replicated."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = len(devs) if n_devices is None else int(n_devices)
+    if n < 1 or n > len(devs):
+        raise ValueError(
+            f"mesh wants {n} devices but the platform exposes {len(devs)}"
+        )
+    return Mesh(np.asarray(devs[:n]), ("dp",))
+
+
+def resolve_mesh(mesh):
+    """Normalize a frontend ``mesh=`` argument ONCE, host-side (never at
+    trace time — the compile cache keys on the resolved placement):
+
+    - ``None`` → the ``MOSAIC_MESH`` env knob (``"4"`` or ``"dp4"`` →
+      4-device data mesh; unset/empty → single-device dispatch);
+    - an int → :func:`data_mesh` over that many devices;
+    - a `Mesh` → used as-is (must be 1-D for the replicated-index lane).
+    """
+    if mesh is None:
+        raw = os.environ.get("MOSAIC_MESH", "").strip().lower()
+        if not raw:
+            return None
+        if raw.startswith("dp"):
+            raw = raw[2:]
+        try:
+            n = int(raw)
+        except ValueError:
+            raise ValueError(
+                f"MOSAIC_MESH={raw!r}: expected a device count like '4' "
+                "or 'dp4'"
+            ) from None
+        if n <= 1:
+            return None
+        return data_mesh(n)
+    if isinstance(mesh, int):
+        return data_mesh(mesh) if mesh > 1 else None
+    return mesh
+
+
+def _replicated_index_specs():
+    from ..parallel.dist_join import _index_specs
+
+    return _index_specs(P(), P())
+
+
+def sharded_pointwise(fn, mesh: Mesh, *, n_out: int = 1, check_rep: bool = True):
+    """Wrap a point-wise probe ``fn(points, cells, index, ...) -> out``
+    in a data-parallel `shard_map`: points/cells sharded over the 1-D
+    mesh, ChipIndex replicated (no all-gather — the index fits HBM; the
+    cell-sharded big-index layout stays `parallel.dist_join`'s). Each
+    output axis 0 is point-sharded. Because every per-point result
+    depends only on that point and the replicated index, the wrapped
+    program is bit-identical to single-device execution."""
+    from ..parallel._compat import shard_map as _shard_map
+
+    pspec = P(mesh.axis_names)
+    ispec = _replicated_index_specs()
+    out_specs = pspec if n_out == 1 else tuple(pspec for _ in range(n_out))
+    return _shard_map(
+        fn, mesh=mesh, in_specs=(pspec, pspec, ispec),
+        out_specs=out_specs, check_rep=check_rep,
+    )
+
+
+@bounded_cache("sharded_join", 32)
+def sharded_join_prog(
+    mesh: Mesh,
+    *,
+    writeback: str,
+    lookup: str,
+    probe: str,
+    found_cap,
+    heavy_cap,
+    convex_cap,
+):
+    """One jitted sharded exact join per (mesh, static args): the
+    single-device executable's multi-chip twin. Caps are PER-SHARD
+    (full per-shard rows under the ladder) so overflow stays
+    structurally impossible at any device count."""
+    m = _join_mod()
+
+    def step(shifted, cells, index):
+        return m.pip_join_points(
+            shifted, cells, index,
+            heavy_cap=heavy_cap, found_cap=found_cap,
+            writeback=writeback, lookup=lookup,
+            probe=probe, convex_cap=convex_cap,
+        )
+
+    return jax.jit(sharded_pointwise(
+        step, mesh, check_rep=probe_check_rep(probe),
+    ))
+
+
+# ---------------------------------------------------------- DispatchCore
+
+class DispatchCore:
+    """One bucketed, warmed, resilient execution path over a resident
+    ChipIndex — the unit every frontend delegates to.
+
+    Owns: the pad-to-bucket ladder, full-(per-shard-)bucket caps, the
+    `(bucket, index, mesh)` signature set with cold-compile accounting,
+    :meth:`warmup` precompiling every rung, and the guarded execute path
+    (watchdog + retry + f64 host-oracle degradation). With ``mesh`` set,
+    dispatches run data-parallel with the index replicated — results are
+    bit-identical to single-device at every device count.
+    """
+
+    def __init__(
+        self,
+        index,
+        index_system,
+        resolution: int,
+        *,
+        ladder: BucketLadder | None = None,
+        writeback: str = "scatter",
+        lookup: str | None = None,
+        probe: str = "scatter",
+        cell_dtype=None,
+        mesh=None,
+        on_cold_compile=None,
+    ):
+        self.index = index
+        self.index_system = index_system
+        self.resolution = index_system.resolution_arg(resolution)
+        self.ladder = ladder or BucketLadder()
+        self.writeback = writeback
+        # force-lane env resolution happens once, here — dispatch uses
+        # the pinned value so the compile-cache signature stays honest
+        self.probe = _join_mod().resolve_probe_mode(probe)
+        if self.probe != "scatter" and writeback == "direct":
+            raise ValueError(
+                "probe='adaptive' requires writeback scatter|gather"
+            )
+        self.cell_dtype = cell_dtype
+        self.mesh = resolve_mesh(mesh)
+        if self.mesh is not None and self.ladder.min_bucket % self.mesh.size:
+            raise ValueError(
+                f"min_bucket {self.ladder.min_bucket} must divide evenly "
+                f"over the {self.mesh.size}-device mesh"
+            )
+        dtype = index.border.verts.dtype
+        if lookup is None:
+            lookup = (
+                "mxu"
+                if jax.devices()[0].platform != "cpu"
+                and dtype == jnp.float32
+                else "gather"
+            )
+        self.lookup = lookup
+        self._dtype = dtype
+        host = getattr(index, "host", None)
+        self._host = host
+        self._shift = (
+            host.shift
+            if host is not None
+            else np.asarray(index.border.shift, dtype=np.float64)
+        )
+        self._signatures: set = set()
+        self._warmed: frozenset | None = None
+        self._cold_compiles = 0
+        self._on_cold_compile = on_cold_compile
+
+    # ------------------------------------------------------- accounting
+
+    @property
+    def signatures(self) -> set:
+        return self._signatures
+
+    @property
+    def cold_compiles(self) -> int:
+        return self._cold_compiles
+
+    @property
+    def warmed(self) -> bool:
+        return self._warmed is not None
+
+    def caps(self, bucket: int):
+        """Full-bucket caps — PER SHARD under a mesh — so tier overflow
+        is structurally impossible and the static-arg set per bucket
+        never changes at runtime."""
+        rows = bucket if self.mesh is None else bucket // self.mesh.size
+        fcap = None if self.writeback == "direct" else rows
+        hcap = rows if self.index.num_heavy_cells else None
+        ccap = (
+            rows
+            if self.probe != "scatter" and self.index.num_convex_cells
+            else None
+        )
+        return fcap, hcap, ccap
+
+    def signature(self, bucket: int) -> tuple:
+        fcap, hcap, ccap = self.caps(bucket)
+        return dispatch_signature(
+            bucket, self.index, writeback=self.writeback,
+            lookup=self.lookup, found_cap=fcap, heavy_cap=hcap,
+            probe=self.probe, convex_cap=ccap, mesh=self.mesh,
+        )
+
+    def freeze(self) -> None:
+        """Snapshot the signature set — any later dispatch introducing a
+        new signature counts as a cold compile (the bounded-compile
+        contract's tripwire)."""
+        self._warmed = frozenset(self._signatures)
+
+    # ---------------------------------------------------------- execute
+
+    def execute_padded(self, padded: np.ndarray) -> np.ndarray:
+        """One exact device join of a full-bucket batch (the compile
+        unit warmup precompiles and dispatch replays); sharded over the
+        mesh when one is bound."""
+        bucket = padded.shape[0]
+        if self.mesh is not None and bucket % self.mesh.size:
+            raise ValueError(
+                f"bucket {bucket} does not divide over the "
+                f"{self.mesh.size}-device mesh"
+            )
+        fcap, hcap, ccap = self.caps(bucket)
+        sig = self.signature(bucket)
+        if sig not in self._signatures:
+            self._signatures.add(sig)
+            if self._warmed is not None:
+                self._cold_compiles += 1
+                if self._on_cold_compile is not None:
+                    self._on_cold_compile(bucket, len(self._signatures))
+                else:
+                    _telemetry.record(
+                        "dispatch_compile", bucket=bucket,
+                        signatures=len(self._signatures),
+                    )
+        dev = jnp.asarray(padded)
+        if self.cell_dtype is not None:
+            dev = dev.astype(self.cell_dtype)
+        # always the JITTED cell program (shared `cells_prog` lru, one
+        # compile per bucket, precompiled by warmup): the batch-path
+        # heuristic of going eager below 64k rows on CPU trades a
+        # one-off compile for a ~1000x slower dispatch — the right trade
+        # for a single cold batch, the wrong one on a hot path
+        cells = cells_prog(self.index_system, self.resolution, "cells")(dev)
+        shifted = jnp.asarray(padded - self._shift, dtype=self._dtype)
+        if self.mesh is None:
+            out = jit_join()(
+                shifted, cells, self.index,
+                heavy_cap=hcap, found_cap=fcap,
+                writeback=self.writeback, lookup=self.lookup,
+                probe=self.probe, convex_cap=ccap,
+            )
+        else:
+            prog = sharded_join_prog(
+                self.mesh, writeback=self.writeback, lookup=self.lookup,
+                probe=self.probe, found_cap=fcap, heavy_cap=hcap,
+                convex_cap=ccap,
+            )
+            out = prog(shifted, cells, self.index)
+        return np.asarray(out)
+
+    def execute(self, points) -> np.ndarray:
+        """Pad → dispatch → unpad (exact, unguarded)."""
+        padded, n = self.ladder.pad(points)
+        return self.execute_padded(padded)[:n]
+
+    def execute_resilient(
+        self, site: str, padded: np.ndarray, *,
+        default_s=None, policy=None,
+    ) -> np.ndarray:
+        """:meth:`execute_padded` under the ``site`` watchdog deadline,
+        transient retry, and exact-f64 host-oracle degradation."""
+        fallback = None
+        if self._host is not None:
+            m = _join_mod()
+            fallback = lambda: m.host_join(  # noqa: E731
+                padded, self._host, self.index_system, self.resolution
+            )
+        return guarded_call(
+            site, self.execute_padded, padded,
+            default_s=default_s, policy=policy, fallback=fallback,
+        )
+
+    # ----------------------------------------------------------- warmup
+
+    def warmup(self) -> dict:
+        """Precompile every ladder bucket against the resident index
+        (on the bound mesh), then freeze the signature set. Returns
+        ``{"buckets", "seconds", "signatures"}`` plus the real
+        ``backend_compiles`` delta when the XLA meter is available."""
+        t0 = backend_compiles()
+        with _telemetry.capture() as events, _trace.span(
+            "dispatch.warmup", buckets=len(self.ladder.buckets),
+            devices=1 if self.mesh is None else self.mesh.size,
+        ):
+            for b in self.ladder.buckets:
+                pts = np.zeros((b, 2), dtype=np.float64)
+                with _telemetry.timed(
+                    "dispatch_stage", stage="warmup", bucket=b
+                ):
+                    self.execute_padded(pts)
+        total = sum(
+            e["seconds"]
+            for e in events
+            if e.get("stage") == "warmup" and "seconds" in e
+        )
+        self.freeze()
+        t1 = backend_compiles()
+        out = {
+            "buckets": len(self.ladder.buckets),
+            "seconds": round(total, 4),
+            "signatures": len(self._signatures),
+        }
+        if t0 is not None and t1 is not None:
+            out["backend_compiles"] = t1 - t0
+        _telemetry.record("dispatch_warmup", **out)
+        return out
+
+
+# -------------------------------------------- batch-path core memoization
+
+class _CoreCache:
+    """A tiny bounded insertion-order cache for batch-path
+    :class:`DispatchCore` instances, speaking the `lru_cache`
+    `cache_info()`/`cache_clear()` protocol so it registers in
+    :func:`cache_stats` like every other dispatch cache."""
+
+    def __init__(self, maxsize: int):
+        self.maxsize = maxsize
+        self._d: dict = {}
+        self._hits = 0
+        self._misses = 0
+
+    def get(self, key):
+        core = self._d.get(key)
+        if core is not None:
+            self._hits += 1
+        return core
+
+    def put(self, key, core):
+        self._misses += 1
+        while len(self._d) >= self.maxsize:
+            self._d.pop(next(iter(self._d)))
+        self._d[key] = core
+
+    def cache_info(self):
+        return functools._CacheInfo(
+            self._hits, self._misses, self.maxsize, len(self._d)
+        )
+
+    def cache_clear(self):
+        self._d.clear()
+        self._hits = 0
+        self._misses = 0
+
+
+_BATCH_CORES = _CoreCache(maxsize=8)
+_CACHES["batch_cores"] = _BATCH_CORES
+
+
+def core_for(
+    index,
+    index_system,
+    resolution: int,
+    *,
+    ladder: BucketLadder | None = None,
+    writeback: str = "scatter",
+    lookup: str | None = None,
+    probe: str = "scatter",
+    cell_dtype=None,
+    mesh=None,
+) -> DispatchCore:
+    """The process-cached :class:`DispatchCore` for a (index, placement,
+    static-args) combination — repeated `pip_join(mesh=...)` calls and
+    the multichip bench reuse one warmed core instead of re-tracking
+    signatures per call. The cache holds the index strongly, so the
+    `id(index)` component of the key cannot be recycled while the entry
+    lives."""
+    mesh = resolve_mesh(mesh)
+    key = (
+        id(index), id(index_system), index_system.resolution_arg(resolution),
+        writeback, lookup, probe, str(cell_dtype), mesh_key(mesh),
+        ladder or BucketLadder(),
+    )
+    core = _BATCH_CORES.get(key)
+    if core is None or core.index is not index:
+        core = DispatchCore(
+            index, index_system, resolution, ladder=ladder,
+            writeback=writeback, lookup=lookup, probe=probe,
+            cell_dtype=cell_dtype, mesh=mesh,
+        )
+        _BATCH_CORES.put(key, core)
+    return core

@@ -26,9 +26,8 @@ import numpy as np
 from ..core.index.base import IndexSystem
 from ..core.tessellate import tessellate
 from ..functions._coerce import to_packed
-from ..runtime import faults as _faults
+from ..dispatch import core as _dispatch
 from ..runtime.errors import DegradedResult
-from ..runtime.retry import call_with_retry
 from .core import CheckpointManager
 
 
@@ -336,7 +335,7 @@ def _resilient_distances(ring, dl, dc, li, ci, land, cand):
         return np.zeros(0)
 
     def device_eval():
-        _faults.maybe_fail("knn.pair_distances")
+        # the "knn.pair_distances" fault plan trips inside guarded_call
         return ring.pair_distances(dl, dc, li, ci)
 
     def oracle_eval():
@@ -347,8 +346,8 @@ def _resilient_distances(ring, dl, dc, li, ci, land, cand):
             dtype=np.float64,
         )
 
-    return call_with_retry(
-        device_eval, label="knn.pair_distances", fallback=oracle_eval
+    return _dispatch.guarded_call(
+        "knn.pair_distances", device_eval, fallback=oracle_eval
     )
 
 

@@ -20,7 +20,6 @@ No shuffle, no host round-trip: one `shard_map`-ped XLA program per step.
 from __future__ import annotations
 
 import dataclasses
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -30,10 +29,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.geometry.device import DeviceGeometry
 from ._compat import shard_map as _shard_map
+from ..dispatch import core as _dispatch
 from ..runtime import faults as _faults, telemetry as _telemetry
 from ..runtime.errors import DegradedResult, RetryExhausted
 from ..runtime.escalate import run_escalating
-from ..runtime.retry import call_with_retry
 from ..sql.join import (
     OVERFLOW,
     ChipIndex,
@@ -298,14 +297,15 @@ def pad_points(points: np.ndarray, cells: np.ndarray, multiple: int):
     )
 
 
-@functools.lru_cache(maxsize=32)
+@_dispatch.bounded_cache("dist_join_step", 32)
 def _cached_step(
     mesh, num_zones, table_size, found_cap, heavy_cap,
     probe="scatter", convex_cap=None,
 ):
     """One compiled step per (mesh, zones, layout, caps, probe) —
     escalation re-enters here with grown caps, so only distinct cap sets
-    compile."""
+    compile. Registered in the dispatch cache registry
+    (`dispatch.cache_stats()["dist_join_step"]`)."""
     return distributed_join_step(
         mesh, num_zones, table_size=table_size,
         found_cap=found_cap, heavy_cap=heavy_cap,
@@ -374,7 +374,8 @@ def dist_pip_join(
     pj, cj = jnp.asarray(p), jnp.asarray(c)
 
     def attempt(capset):
-        _faults.maybe_fail("dist_join.step")
+        # fault plans for "dist_join.step" trip inside guarded_call's
+        # watchdog (which evaluates maybe_fail/planned_stall pre-dispatch)
         step = _cached_step(
             mesh, num_zones, table_size,
             capset.get("found_cap"), capset.get("heavy_cap"),
@@ -385,7 +386,7 @@ def dist_pip_join(
 
     try:
         (match, counts), _ = run_escalating(
-            lambda cc: call_with_retry(attempt, cc, label="dist_join.step"),
+            lambda cc: _dispatch.guarded_call("dist_join.step", attempt, cc),
             grow, ceilings,
             overflow_count=lambda r: int((r[0] == OVERFLOW).sum()),
             stage="dist_pip_join",

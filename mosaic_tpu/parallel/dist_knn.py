@@ -14,23 +14,23 @@ axis is embarrassingly parallel; the top-k merge stays on host in
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..core.geometry.device import DeviceGeometry, take_rows
+from ..dispatch import core as _dispatch
 from ..runtime import telemetry as _telemetry
 from ._compat import shard_map as _shard_map
 from .dist_overlay import geom_specs
 
 
-@functools.lru_cache(maxsize=8)
+@_dispatch.bounded_cache("knn_sharded_distance", 8)
 def _sharded_distance_fn(mesh: Mesh):
     """One jitted shard_map per mesh — KNN calls this every ring
     iteration, so the jit object must persist for XLA's trace cache to
-    hit (a fresh closure per call would recompile every iteration)."""
+    hit (a fresh closure per call would recompile every iteration).
+    Lives in the dispatch cache registry as ``knn_sharded_distance``."""
     from ..functions.geometry import _distance_dense, _vmap_pair
 
     row = P(mesh.axis_names)
@@ -71,36 +71,30 @@ def distributed_pair_distances(
 
 
 def knn_cache_stats(emit: bool = True) -> dict:
-    """Observability for the per-mesh distance-program cache, mirroring
-    ``sql.join.join_cache_stats``.
+    """Compatibility view over the unified dispatch cache registry
+    (`dispatch.cache_stats` is the full surface; this keeps the
+    historical ``{"sharded_distance": {...}}`` dict shape).
 
-    ``{"sharded_distance": {hits, misses, maxsize, currsize}}`` — each
-    live entry pins one jitted shard_map program (and its `Mesh` key)
-    for the cache's lifetime. The lru is bounded (maxsize 8: a process
-    rarely cycles more than a couple of mesh shapes; eviction just costs
-    one recompile on the next ring iteration over that mesh). Emits one
-    ``knn_cache_stats`` telemetry event (``emit=False`` reads silently).
+    Each live entry pins one jitted shard_map program (and its `Mesh`
+    key) for the cache's lifetime. The lru is bounded (maxsize 8: a
+    process rarely cycles more than a couple of mesh shapes; eviction
+    just costs one recompile on the next ring iteration over that mesh).
+    Emits one ``knn_cache_stats`` telemetry event (``emit=False`` reads
+    silently).
     """
-    info = _sharded_distance_fn.cache_info()
-    stats = {
-        "sharded_distance": {
-            "hits": info.hits,
-            "misses": info.misses,
-            "maxsize": info.maxsize,
-            "currsize": info.currsize,
-        },
-    }
+    stats = {"sharded_distance": _dispatch.cache_view("knn_sharded_distance")}
     if emit:
         _telemetry.record("knn_cache_stats", **stats)
     return stats
 
 
 def clear_knn_caches() -> dict:
-    """Drop every cached per-mesh distance program; returns the
-    pre-clear :func:`knn_cache_stats`. The next ring iteration per mesh
-    pays one recompile. Emits ``knn_caches_cleared`` telemetry.
+    """Drop every cached per-mesh distance program (through
+    `dispatch.clear_caches`); returns the pre-clear
+    :func:`knn_cache_stats`. The next ring iteration per mesh pays one
+    recompile. Emits ``knn_caches_cleared`` telemetry.
     """
     stats = knn_cache_stats(emit=False)
-    _sharded_distance_fn.cache_clear()
+    _dispatch.clear_caches(names=("knn_sharded_distance",), emit=False)
     _telemetry.record("knn_caches_cleared", **stats)
     return stats

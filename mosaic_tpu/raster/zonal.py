@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..dispatch import core as _dispatch
 from ..kernels.zonal import zonal_fold, zonal_tiled
 from ..obs import trace as _trace
 from ..runtime import faults as _faults, telemetry as _telemetry
@@ -140,11 +141,17 @@ class ZonalEngine:
         probe: str = "adaptive",
         convex_cap: "int | None" = None,
         lane: str = "auto",
+        mesh=None,
     ):
         self.index_system = index_system
         self.resolution = int(resolution)
         self.chip_index = chip_index
         self.lane = resolve_zonal_lane(lane)
+        # placement resolves host-side once (dispatch core discipline):
+        # with a mesh bound, the PIP probe runs data-parallel over the
+        # pixel stream with the ChipIndex replicated — bit-identical to
+        # single-device, so the fold contract is untouched
+        self.mesh = _dispatch.resolve_mesh(mesh)
         self.num_zones = (
             0 if chip_index is None
             else int(np.asarray(chip_index.chip_geom).max()) + 1
@@ -196,13 +203,7 @@ class ZonalEngine:
                     dtype=dtype,
                 )
 
-            def zones_probe(gt, origin, index, th: int, tw: int):
-                cells = assign_tile_cells(
-                    gt, origin, (th, tw), index_system, resolution
-                )
-                pts = tile_centers(
-                    jnp.asarray(gt), jnp.asarray(origin), th=th, tw=tw
-                )
+            def probe_core(pts, cells, index):
                 shifted = (pts - index.border.shift).astype(dtype)
                 out = pip_join_points(
                     shifted, cells, index,
@@ -214,6 +215,24 @@ class ZonalEngine:
                 if eps2 is None:
                     return out, jnp.zeros(out.shape, bool)
                 return out  # (geom, near) under the epsilon band
+
+            if self.mesh is not None:
+                # per-pixel results depend only on the pixel center and
+                # the replicated index — sharding the probe stream over
+                # the mesh is bit-identical by construction
+                probe_core = _dispatch.sharded_pointwise(
+                    probe_core, self.mesh, n_out=2,
+                    check_rep=_dispatch.probe_check_rep(probe),
+                )
+
+            def zones_probe(gt, origin, index, th: int, tw: int):
+                cells = assign_tile_cells(
+                    gt, origin, (th, tw), index_system, resolution
+                )
+                pts = tile_centers(
+                    jnp.asarray(gt), jnp.asarray(origin), th=th, tw=tw
+                )
+                return probe_core(pts, cells, index)
 
             self._zones_probe = jax.jit(zones_probe, static_argnums=(3, 4))
 
@@ -234,6 +253,12 @@ class ZonalEngine:
         patch is what makes the fold bit-identical to the f64 oracle even
         for pixel centers landing exactly on zone edges."""
         th, tw = plan.shape
+        if self.mesh is not None and (th * tw) % self.mesh.size:
+            raise ValueError(
+                f"tile of {th * tw} pixels does not divide over the "
+                f"{self.mesh.size}-device mesh — pick a tile shape whose "
+                "pixel count is a multiple of the device count"
+            )
         gt6 = np.asarray(plan.gt, np.float64)
         geom_d, near_d = self._zones_probe(
             gt6, plan.origins[t], self.chip_index, th, tw

@@ -244,4 +244,12 @@ def capture():
     try:
         yield events
     finally:
-        _sinks().remove(events)
+        # detach by IDENTITY: list.remove compares by equality, and a
+        # nested capture sees the same event dicts as its enclosing one
+        # (both sinks receive every append) — equality-based removal
+        # would detach the OUTER scope and leak the inner
+        s = _sinks()
+        for i in range(len(s) - 1, -1, -1):
+            if s[i] is events:
+                del s[i]
+                break

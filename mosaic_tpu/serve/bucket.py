@@ -1,141 +1,22 @@
-"""Shape bucketing: the bounded-compile-cache contract of the serving
-engine.
+"""Compatibility shim: the shape-bucketing contract moved to
+`mosaic_tpu.dispatch.bucket` when the dispatch core unified the four
+frontend execution paths — serve was its first owner, every frontend
+now shares it. Import from `mosaic_tpu.dispatch` in new code."""
 
-XLA specializes one executable per input shape, so serving raw request
-shapes would compile an unbounded program population (and a cold compile
-on the latency path is a multi-second p99 spike — the one thing an
-online engine must never do). Every device dispatch therefore runs at a
-shape drawn from a small fixed ladder: a request (or coalesced
-micro-batch) of ``n`` rows is padded up to ``bucket_for(n)``, and
-:meth:`ServeEngine.warmup` precompiles every (bucket, index) program
-before traffic arrives. After warmup the dispatch path can only replay
-cached executables — the serve tests pin "zero new compile signatures
-after warmup" over randomized request sizes.
+from ..dispatch.bucket import (  # noqa: F401
+    DEFAULT_MAX_BUCKET,
+    DEFAULT_MIN_BUCKET,
+    BucketLadder,
+    backend_compiles,
+    dispatch_signature,
+    mesh_key,
+)
 
-Pad rows duplicate the batch's first row: they flow through the probe
-like any other point (no special-casing in the kernel, no risk of a
-reserved coordinate colliding with real data) and are sliced off before
-scatter-back, so they can never reach a caller. Caps sized at the full
-bucket make tier overflow structurally impossible — a padded dispatch
-is exact by construction, never escalates, and therefore never changes
-its compile signature at runtime.
-
-Compile accounting is two-layered: :func:`dispatch_signature` is the
-deterministic cache key the engine counts (signatures after warmup ==
-buckets touched), and :func:`backend_compiles` reads a process-wide
-XLA compile counter (best effort, via jax's monitoring events) so the
-bench can report REAL compiles, not just intended ones.
-"""
-
-from __future__ import annotations
-
-import dataclasses
-
-import numpy as np
-
-#: default ladder bounds: 64 covers single interactive requests, 64k is
-#: one comfortable device micro-batch (the batcher's max coalesced size
-#: must not exceed the top bucket)
-DEFAULT_MIN_BUCKET = 64
-DEFAULT_MAX_BUCKET = 65536
-
-
-@dataclasses.dataclass(frozen=True)
-class BucketLadder:
-    """Geometric pad-to-bucket ladder (powers of ``growth`` from
-    ``min_bucket`` to ``max_bucket`` inclusive)."""
-
-    min_bucket: int = DEFAULT_MIN_BUCKET
-    max_bucket: int = DEFAULT_MAX_BUCKET
-    growth: int = 2
-
-    def __post_init__(self):
-        if self.min_bucket < 1 or self.max_bucket < self.min_bucket:
-            raise ValueError(
-                f"invalid ladder bounds [{self.min_bucket}, "
-                f"{self.max_bucket}]"
-            )
-        if self.growth < 2:
-            raise ValueError(f"growth must be >= 2, got {self.growth}")
-
-    @property
-    def buckets(self) -> tuple:
-        out = []
-        b = self.min_bucket
-        while b < self.max_bucket:
-            out.append(b)
-            b *= self.growth
-        out.append(self.max_bucket)
-        return tuple(out)
-
-    def bucket_for(self, n: int) -> int:
-        """Smallest bucket >= ``n`` (raises for n > max_bucket: the
-        batcher sizes its coalescing window so this cannot happen for
-        admitted traffic)."""
-        if n > self.max_bucket:
-            raise ValueError(
-                f"request of {n} rows exceeds the top bucket "
-                f"{self.max_bucket} — raise max_bucket or split upstream"
-            )
-        b = self.min_bucket
-        while b < n:
-            b *= self.growth
-        return min(b, self.max_bucket)
-
-    def pad(self, points: np.ndarray) -> tuple[np.ndarray, int]:
-        """(padded (B, 2) f64 copy, original n). Pad rows repeat row 0
-        (inert: results past ``n`` are sliced off before scatter-back)."""
-        pts = np.asarray(points, dtype=np.float64)
-        n = int(pts.shape[0])
-        b = self.bucket_for(max(n, 1))
-        if n == b:
-            return pts, n
-        out = np.empty((b, 2), dtype=np.float64)
-        out[:n] = pts
-        out[n:] = pts[0] if n else 0.0
-        return out, n
-
-
-def dispatch_signature(
-    bucket: int, index, *, writeback: str, lookup: str,
-    found_cap: int | None, heavy_cap: int | None,
-    probe: str = "scatter", convex_cap: int | None = None,
-) -> tuple:
-    """The deterministic compile-cache key of one serve dispatch: the
-    full static-argument set of the module-level jitted join plus the
-    padded shape and index identity. Two dispatches with equal
-    signatures replay the same executable; the engine asserts the
-    signature set stops growing after :meth:`ServeEngine.warmup`."""
-    return (
-        int(bucket), id(index), writeback, lookup, found_cap, heavy_cap,
-        probe, convex_cap,
-    )
-
-
-_METER = {"installed": False, "count": 0}
-
-
-def _install_meter() -> None:
-    if _METER["installed"]:
-        return
-    _METER["installed"] = True
-    try:
-        from jax._src import monitoring
-
-        def _on_duration(name: str, dur: float, **kw) -> None:
-            if name.endswith("backend_compile_duration"):
-                _METER["count"] += 1
-
-        monitoring.register_event_duration_secs_listener(_on_duration)
-        _METER["available"] = True
-    except Exception:  # lint: broad-except-ok (xla monitoring listener is optional; meter reports unavailable)
-        _METER["available"] = False
-
-
-def backend_compiles() -> int | None:
-    """Process-wide XLA backend-compile count since the meter was first
-    read (monotonic; diff two reads to scope a region). ``None`` when
-    this jax build exposes no monitoring hook — callers fall back to
-    signature counting, which upper-bounds real compiles."""
-    _install_meter()
-    return _METER["count"] if _METER.get("available") else None
+__all__ = [
+    "BucketLadder",
+    "DEFAULT_MAX_BUCKET",
+    "DEFAULT_MIN_BUCKET",
+    "backend_compiles",
+    "dispatch_signature",
+    "mesh_key",
+]

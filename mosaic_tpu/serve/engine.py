@@ -3,55 +3,45 @@ device dispatch with the full runtime resilience stack wired in.
 
 One :class:`ServeEngine` owns a resident
 :class:`~mosaic_tpu.sql.join.ChipIndex` and turns concurrent
-point-in-polygon requests into micro-batched dispatches on the
-module-level jitted join (`sql/join._JIT_JOIN` — the same executable
-cache `pip_join` uses, so a server and a batch job in one process share
-compiles). The pipeline per batch:
+point-in-polygon requests into micro-batched dispatches on the unified
+dispatch core (`mosaic_tpu/dispatch` — the same executable cache
+`pip_join` and the stream/raster frontends use, so a server and a batch
+job in one process share compiles). The pipeline per batch:
 
     admit (quarantine + backpressure, `serve/admission.py`)
       -> coalesce (max-batch / max-wait window, `serve/batcher.py`)
-      -> pad to bucket (`serve/bucket.py`)
-      -> assign cells + probe under the ``serve.dispatch``
-         watchdog/fault site, transient retry, host-oracle degradation
+      -> pad to bucket + execute through `dispatch.DispatchCore` under
+         the ``serve.dispatch`` watchdog/fault site, transient retry,
+         host-oracle degradation (`dispatch.guarded_call` owns the
+         composition; the engine only names the site and the deadline)
       -> scatter back per request, shedding only deadline-expired ones
 
-Resilience wiring (all reused, none reimplemented):
-
-- `runtime/watchdog.py` guards the blocking dispatch; its default
-  deadline is the batch's largest remaining request deadline (plus
-  grace), so a hung device surfaces as a typed ``StalledDeviceError``
-  while the requests still have budget to retry or degrade.
-- `runtime/retry.py` retries transient failures with backoff; past the
-  budget the batch degrades to the exact f64 host oracle and every
-  result is flagged :class:`DegradedResult` — callers get exact values
-  and the truth about how they were computed.
 - `runtime/faults.py` sites ``serve.admit`` / ``serve.batch`` /
   ``serve.dispatch`` make every failure mode injectable from tests.
 - every stage emits ``serve_stage`` `telemetry.timed` events; per-request
   latency lands in ``serve_request`` events (`telemetry.summarize` turns
   them into the bench's p50/p99).
 
-Compile discipline: caps are fixed at the full bucket (overflow is
-structurally impossible, so no escalation can change a static argument
-at runtime), and :meth:`warmup` precompiles every bucket against the
-resident index. After warmup the signature set is frozen — a dispatch
-introducing a new signature emits a ``serve_compile`` event and counts
-in ``metrics()["cold_compiles"]`` (the serve tests pin this at zero).
+Compile discipline is the core's: caps fixed at the full (per-shard)
+bucket, one signature per `(bucket, index, mesh)`, :meth:`warmup`
+precompiling every rung. After warmup the signature set is frozen — a
+dispatch introducing a new signature emits a ``serve_compile`` event and
+counts in ``metrics()["cold_compiles"]`` (the serve tests pin this at
+zero). Pass ``mesh=`` (or set ``MOSAIC_MESH``) to place every dispatch
+data-parallel over a device mesh with the index replicated —
+bit-identical results at any device count.
 """
 
 from __future__ import annotations
 
 import numpy as np
 
+from ..dispatch import BucketLadder, DispatchCore, backend_compiles
 from ..obs import trace as _trace
-from ..runtime import telemetry as _telemetry, watchdog as _watchdog
-from ..runtime.retry import call_with_retry
-from ..sql import join as _join
+from ..runtime import telemetry as _telemetry
 from .admission import AdmissionController
 from .batcher import MicroBatcher
-from .bucket import BucketLadder, backend_compiles, dispatch_signature
 
-import jax
 import jax.numpy as jnp
 
 
@@ -82,41 +72,27 @@ class ServeEngine:
         cell_dtype=None,
         watchdog_grace_s: float = 0.5,
         probe: str = "scatter",
+        mesh=None,
     ):
         self.index = index
         self.index_system = index_system
         self.resolution = index_system.resolution_arg(resolution)
         self.ladder = ladder or BucketLadder()
         self.writeback = writeback
-        # force-lane env resolution happens once, here — dispatch uses
-        # the pinned value so the compile-cache signature stays honest
-        self.probe = _join.resolve_probe_mode(probe)
-        if self.probe != "scatter" and writeback == "direct":
-            raise ValueError(
-                "probe='adaptive' requires writeback scatter|gather"
-            )
         self.cell_dtype = cell_dtype
         self.watchdog_grace_s = float(watchdog_grace_s)
-        dtype = index.border.verts.dtype
-        if lookup is None:
-            lookup = (
-                "mxu"
-                if jax.devices()[0].platform != "cpu"
-                and dtype == jnp.float32
-                else "gather"
-            )
-        self.lookup = lookup
-        self._dtype = dtype
-        host = getattr(index, "host", None)
-        self._host = host
-        self._shift = (
-            host.shift
-            if host is not None
-            else np.asarray(index.border.shift, dtype=np.float64)
+        # the core owns probe/lookup resolution (force-lane env folds
+        # once, so the compile-cache signature stays honest), caps,
+        # signature accounting, and the guarded execute path
+        self.core = DispatchCore(
+            index, index_system, resolution, ladder=self.ladder,
+            writeback=writeback, lookup=lookup, probe=probe,
+            cell_dtype=cell_dtype, mesh=mesh,
+            on_cold_compile=self._on_cold_compile,
         )
-        self._signatures: set = set()
-        self._warmed: frozenset | None = None
-        self._cold_compiles = 0
+        self.probe = self.core.probe
+        self.lookup = self.core.lookup
+        self.mesh = self.core.mesh
 
         self.admission = AdmissionController(
             capacity=queue_capacity,
@@ -187,18 +163,18 @@ class ServeEngine:
                 with _telemetry.timed(
                     "serve_stage", stage="warmup", bucket=b
                 ):
-                    self._dispatch_device(pts)
+                    self.core.execute_padded(pts)
         total = sum(
             e["seconds"]
             for e in events
             if e.get("stage") == "warmup" and "seconds" in e
         )
-        self._warmed = frozenset(self._signatures)
+        self.core.freeze()
         t1 = backend_compiles()
         out = {
             "buckets": len(self.ladder.buckets),
             "seconds": round(total, 4),
-            "signatures": len(self._signatures),
+            "signatures": len(self.core.signatures),
         }
         if t0 is not None and t1 is not None:
             out["backend_compiles"] = t1 - t0
@@ -212,8 +188,8 @@ class ServeEngine:
         out["shed"] = a["shed_queue_full"] + b["shed_deadline"]
         out["quarantined"] = a["quarantined_rows"]
         out["queue_depth"] = self.admission.depth()
-        out["compile_signatures"] = len(self._signatures)
-        out["cold_compiles"] = self._cold_compiles
+        out["compile_signatures"] = len(self.core.signatures)
+        out["cold_compiles"] = self.core.cold_compiles
         out["occupancy_mean"] = round(
             b["occupancy_sum"] / b["batches"], 4
         ) if b["batches"] else 0.0
@@ -248,79 +224,24 @@ class ServeEngine:
         occupancy = n / bucket
         return out[:n], occupancy
 
-    def _caps(self, bucket: int):
-        """Full-bucket caps: overflow structurally impossible, so the
-        static-arg set per bucket never changes at runtime."""
-        fcap = None if self.writeback == "direct" else bucket
-        hcap = bucket if self.index.num_heavy_cells else None
-        ccap = (
-            bucket
-            if self.probe != "scatter" and self.index.num_convex_cells
-            else None
-        )
-        return fcap, hcap, ccap
-
-    def _dispatch_device(self, padded: np.ndarray) -> np.ndarray:
-        """One exact device join of a full-bucket batch (the compile
-        unit warmup precompiles and dispatch replays)."""
-        bucket = padded.shape[0]
-        fcap, hcap, ccap = self._caps(bucket)
-        sig = dispatch_signature(
-            bucket, self.index, writeback=self.writeback,
-            lookup=self.lookup, found_cap=fcap, heavy_cap=hcap,
-            probe=self.probe, convex_cap=ccap,
-        )
-        if sig not in self._signatures:
-            self._signatures.add(sig)
-            if self._warmed is not None:
-                self._cold_compiles += 1
-                _telemetry.record(
-                    "serve_compile", bucket=bucket,
-                    signatures=len(self._signatures),
-                )
-        dev = jnp.asarray(padded)
-        if self.cell_dtype is not None:
-            dev = dev.astype(self.cell_dtype)
-        # always the JITTED cell program (shared `_cells_prog` lru, one
-        # compile per bucket, precompiled by warmup): the batch-path
-        # heuristic of going eager below 64k rows on CPU trades a
-        # one-off compile for a ~1000x slower dispatch — the right trade
-        # for a single cold batch, the wrong one on a serving hot path
-        cells = _join._cells_prog(
-            self.index_system, self.resolution, "cells"
-        )(dev)
-        shifted = jnp.asarray(padded - self._shift, dtype=self._dtype)
-        return np.asarray(
-            _join._JIT_JOIN(
-                shifted, cells, self.index,
-                heavy_cap=hcap, found_cap=fcap,
-                writeback=self.writeback, lookup=self.lookup,
-                probe=self.probe, convex_cap=ccap,
-            )
+    def _on_cold_compile(self, bucket: int, signatures: int) -> None:
+        """Core callback: a post-warmup dispatch introduced a new
+        compile signature — the bounded-compile contract's tripwire."""
+        _telemetry.record(
+            "serve_compile", bucket=bucket, signatures=signatures,
         )
 
     def _dispatch_resilient(self, padded, deadline_hint) -> np.ndarray:
-        """`_dispatch_device` under the watchdog deadline, transient
-        retry, and host-oracle degradation."""
+        """The core's guarded execute under the batch's deadline: the
+        ``serve.dispatch`` watchdog site, transient retry, and exact-f64
+        host-oracle degradation — all composed by the dispatch core."""
         default_s = (
             None
             if deadline_hint is None
             else max(float(deadline_hint), 0.05) + self.watchdog_grace_s
         )
-
-        def attempt():
-            return _watchdog.guard(
-                "serve.dispatch", self._dispatch_device, padded,
-                default_s=default_s,
-            )
-
-        fallback = None
-        if self._host is not None:
-            fallback = lambda: _join.host_join(  # noqa: E731
-                padded, self._host, self.index_system, self.resolution
-            )
-        return call_with_retry(
-            attempt, label="serve.dispatch", fallback=fallback
+        return self.core.execute_resilient(
+            "serve.dispatch", padded, default_s=default_s
         )
 
     # ------------------------------------------------------- quarantine

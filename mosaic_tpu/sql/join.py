@@ -51,15 +51,14 @@ from ..core.geometry.device import (
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
+from ..dispatch import core as _dispatch
 from ..obs import trace as _obs_trace
 from ..runtime import (
     faults as _faults,
     telemetry as _telemetry,
-    watchdog as _watchdog,
 )
 from ..runtime.errors import DegradedResult, RetryExhausted
 from ..runtime.escalate import run_escalating
-from ..runtime.retry import call_with_retry
 from ..utils import get_logger
 
 _SENTINEL = jnp.iinfo(jnp.int32).max
@@ -770,9 +769,6 @@ def _probe_counts(pcells: jax.Array, index: ChipIndex):
     return jnp.stack([nf, nh, nc])
 
 
-_JIT_COUNTS = jax.jit(_probe_counts)
-
-
 def _ray_parity(px, py, edges, bits, eps2=None):
     """XOR-accumulated crossing parity bits.
 
@@ -1298,8 +1294,8 @@ def pip_join_points(
             f"got {compact_block}"
         )
     # validate only — no env fold here: this function is jit-traced
-    # (`_JIT_JOIN` keys its compile cache on the UNRESOLVED `probe`
-    # static arg), so reading MOSAIC_PROBE_FORCE_LANE at this point
+    # (`dispatch.jit_join` keys its compile cache on the UNRESOLVED
+    # `probe` static arg), so reading MOSAIC_PROBE_FORCE_LANE at this point
     # would bake the first-seen lane into the cached program. Host-side
     # entry points (pip_join, stream, serve, dist_join) fold the knob
     # via `resolve_probe_mode` before staging.
@@ -1551,45 +1547,23 @@ def pip_join_points(
     return out
 
 
-# module-level jit so repeated pip_join calls share the compilation cache
-_JIT_JOIN = jax.jit(
-    pip_join_points,
-    static_argnames=(
-        "heavy_cap", "found_cap", "writeback", "lookup", "compaction",
-        "compact_block", "probe", "convex_cap",
-    ),
-)
-
-# the epsilon-band recheck compacts the flagged band with the SAME
-# machinery the probe tiers use (`_compact`), jitted once per cap bucket
-_JIT_COMPACT = jax.jit(_compact, static_argnames=("cap",))
+# the jitted join/counts/compact executables and the cell-assignment
+# program cache are owned by the dispatch core (`dispatch/core.py`) —
+# one compile cache shared by batch, stream, serve, raster, and the
+# sharded lane. `_dispatch.jit_join()` et al. hand back the process-wide
+# wrappers; this module keeps only thin legacy views below.
 
 
 def _next_pow2(n: int, lo: int = 16) -> int:
     return max(lo, 1 << int(np.ceil(np.log2(max(n, 1)))))
 
 
-@functools.lru_cache(maxsize=64)
-def _cells_prog(index_system: IndexSystem, resolution: int, variant: str):
-    """Cached jitted cell-assignment programs per (system, res, variant).
-
-    The lru key keeps a reference to the index system — idempotent systems
-    (all built-ins) are cheap singletons, so the retention is harmless.
-    """
-    if variant == "margin":
-        fn = lambda p: index_system.point_to_cell_margin(p, resolution)  # noqa: E731
-    elif variant == "alt":
-        fn = lambda p: index_system.point_to_cell_alt(p, resolution)  # noqa: E731
-    else:
-        fn = lambda p: index_system.point_to_cell(p, resolution)  # noqa: E731
-    return jax.jit(fn)
-
-
 def join_cache_stats(emit: bool = True) -> dict:
-    """Observability for the module-level join caches.
+    """Legacy view over the unified dispatch cache registry
+    (`dispatch.cache_stats` is the full surface).
 
     ``{"cells_prog": {hits, misses, maxsize, currsize}, "jit_join":
-    n_cached, "jit_compact": n_cached}`` — the `_cells_prog` lru entry
+    n_cached, "jit_compact": n_cached}`` — the `cells_prog` lru entry
     count is the number of live (index system, resolution, variant)
     program keys (each PINS its index-system object for the cache's
     lifetime), and the jit sizes count compiled (shape, static-args)
@@ -1597,52 +1571,24 @@ def join_cache_stats(emit: bool = True) -> dict:
     (``emit=False`` reads silently) so long-running servers can chart
     growth and decide when to call :func:`clear_join_caches`.
     """
-    info = _cells_prog.cache_info()
-    stats = {
-        "cells_prog": {
-            "hits": info.hits,
-            "misses": info.misses,
-            "maxsize": info.maxsize,
-            "currsize": info.currsize,
-        },
-        "jit_join": _jit_cache_size(_JIT_JOIN),
-        "jit_compact": _jit_cache_size(_JIT_COMPACT),
-    }
+    stats = _dispatch.join_cache_view()
     if emit:
         _telemetry.record("join_cache_stats", **stats)
     return stats
 
 
-def _jit_cache_size(fn) -> int:
-    try:
-        return int(fn._cache_size())
-    except Exception:  # lint: broad-except-ok (jax version without the introspection hook; -1 means unknown)
-        return -1
-
-
 def clear_join_caches() -> dict:
-    """Release every module-level join cache; returns the pre-clear
-    :func:`join_cache_stats`.
-
-    The `_cells_prog` lru (maxsize 64) holds a strong reference to every
-    index system it ever compiled for — for the built-in singleton
-    systems that retention is harmless, but a long-running server
-    cycling many `CustomIndexSystem` grids (or resolutions) pins each
-    one for process lifetime. This is the escape hatch: drop the cell
-    programs plus the `_JIT_JOIN`/`_JIT_COMPACT` compile caches (they
-    regrow on next use; the next call per shape pays one recompile).
-    Emits ``join_caches_cleared`` telemetry.
+    """Release the join-owned slice of the dispatch caches (cell
+    programs plus the shared join/compact compile caches — they regrow
+    on next use; the next call per shape pays one recompile); returns
+    the pre-clear :func:`join_cache_stats`. `dispatch.clear_caches`
+    drops EVERY dispatch cache. Emits ``join_caches_cleared`` telemetry.
     """
     stats = join_cache_stats(emit=False)
-    _cells_prog.cache_clear()
-    for fn in (_JIT_JOIN, _JIT_COMPACT):
-        try:
-            fn.clear_cache()
-        except Exception:  # lint: broad-except-ok (older jax spells it _clear_cache)
-            try:
-                fn._clear_cache()
-            except Exception:  # lint: broad-except-ok (no clear hook on this jax; cache drops at process exit)
-                pass
+    _dispatch.clear_caches(
+        names=("cells_prog", "jit_join", "jit_counts", "jit_compact"),
+        emit=False,
+    )
     _telemetry.record("join_caches_cleared", **stats)
     return stats
 
@@ -1659,7 +1605,7 @@ def _assign_cells(index_system, resolution: int, dev: jax.Array, variant: str):
         dev.shape[0] >= _JIT_CELLS_MIN
         or jax.devices()[0].platform != "cpu"
     ):
-        return _cells_prog(index_system, resolution, variant)(dev)
+        return _dispatch.cells_prog(index_system, resolution, variant)(dev)
     if variant == "margin":
         return index_system.point_to_cell_margin(dev, resolution)
     if variant == "alt":
@@ -1681,6 +1627,7 @@ def pip_join(
     cell_margin_k: float | None = None,
     edge_band_k: float | None = None,
     probe: str = "scatter",
+    mesh=None,
 ) -> np.ndarray:
     """Managed join (reference: `PointInPolygonJoin.join` auto-indexes both
     sides, `sql/join/PointInPolygonJoin.scala:86-97`).
@@ -1735,6 +1682,15 @@ def pip_join(
     ``adaptive-heavy`` / ``adaptive-convex`` pin a single lane (also
     reachable via ``MOSAIC_PROBE_FORCE_LANE`` when ``probe="adaptive"``);
     requires a compaction writeback (not ``direct``).
+
+    ``mesh`` routes each chunk through the dispatch core's bucketed
+    data-parallel lane (`dispatch.DispatchCore`): points padded to the
+    ladder bucket and sharded over a 1-D mesh with the ChipIndex
+    replicated, full per-shard caps (no count sync, no escalation — the
+    serve path's compile discipline), bit-identical to single-device.
+    Accepts a device count, a 1-D `jax.sharding.Mesh`, or None (the
+    ``MOSAIC_MESH`` env knob, resolved once per call). Requires
+    ``recheck=False`` — the epsilon-band path stays single-device.
     """
     resolution = index_system.resolution_arg(resolution)
     probe = resolve_probe_mode(probe)
@@ -1749,6 +1705,13 @@ def pip_join(
         from ..context import current_config
 
         recheck = current_config().exact_recheck
+    mesh = _dispatch.resolve_mesh(mesh)
+    if mesh is not None and recheck:
+        raise ValueError(
+            "pip_join(mesh=...) runs the bucketed sharded dispatch lane, "
+            "which does not support the epsilon-band recheck yet — pass "
+            "recheck=False (or drop the mesh for the exact-recheck path)"
+        )
     host: HostRecheck | None = getattr(chip_index, "host", None)
     if recheck and host is None:
         raise ValueError(
@@ -1771,8 +1734,27 @@ def pip_join(
             else "gather"
         )
     n = raw.shape[0]
+    core = (
+        None
+        if mesh is None
+        else _dispatch.core_for(
+            chip_index, index_system, resolution,
+            writeback=writeback, lookup=lookup, probe=probe,
+            cell_dtype=cell_dtype, mesh=mesh,
+        )
+    )
 
     def run(chunk: np.ndarray) -> np.ndarray:
+        if core is not None:
+            # the sharded bucketed lane: pad to the ladder, dispatch
+            # data-parallel with full per-shard caps (overflow
+            # structurally impossible — no count sync, no escalation),
+            # slice the pad off. RetryExhausted falls through to
+            # `run_resilient`'s host-oracle degradation like every lane.
+            padded, nn = core.ladder.pad(chunk)
+            return _dispatch.guarded_call(
+                "pip_join.device", core.execute_padded, padded
+            )[:nn]
         dev = jnp.asarray(chunk)
         if cell_dtype is not None:
             dev = dev.astype(cell_dtype)
@@ -1793,7 +1775,9 @@ def pip_join(
             hcap = (
                 min(
                     _next_pow2(
-                        int(np.asarray(_JIT_COUNTS(cells, chip_index))[1]) + 1
+                        int(np.asarray(
+                            _dispatch.jit_counts()(cells, chip_index)
+                        )[1]) + 1
                     ),
                     chunk.shape[0],
                 )
@@ -1805,7 +1789,8 @@ def pip_join(
             ccap = None
         else:
             nf, nh, nc = (
-                int(v) for v in np.asarray(_JIT_COUNTS(cells, chip_index))
+                int(v)
+                for v in np.asarray(_dispatch.jit_counts()(cells, chip_index))
             )
             fcap = min(_next_pow2(nf + 1), chunk.shape[0])
             hcap = (
@@ -1843,27 +1828,27 @@ def pip_join(
         if not recheck:
 
             def attempt(c):
-                # the watchdog guard evaluates the fault hooks
-                # (maybe_fail + planned stalls) on this thread, then runs
-                # the blocking dispatch under its deadline: a hung device
-                # surfaces as a typed StalledDeviceError on the same
-                # retry path as a tunnel drop, never a silent hang
-                return _watchdog.guard(
-                    "pip_join.device",
-                    lambda: np.asarray(
-                        _JIT_JOIN(
-                            shifted, cells, chip_index,
-                            heavy_cap=c.get("heavy_cap", hcap),
-                            found_cap=c.get("found_cap", fcap),
-                            writeback=writeback, lookup=lookup,
-                            probe=probe,
-                            convex_cap=c.get("convex_cap", ccap),
-                        )
-                    ),
+                return np.asarray(
+                    _dispatch.jit_join()(
+                        shifted, cells, chip_index,
+                        heavy_cap=c.get("heavy_cap", hcap),
+                        found_cap=c.get("found_cap", fcap),
+                        writeback=writeback, lookup=lookup,
+                        probe=probe,
+                        convex_cap=c.get("convex_cap", ccap),
+                    )
                 )
 
+            # `guarded_call` evaluates the fault hooks (maybe_fail +
+            # planned stalls) on this thread, then runs the blocking
+            # dispatch under the site's watchdog deadline with transient
+            # retry: a hung device surfaces as a typed
+            # StalledDeviceError on the same retry path as a tunnel
+            # drop, never a silent hang
             out, _ = run_escalating(
-                lambda c: call_with_retry(attempt, c, label="pip_join.device"),
+                lambda c: _dispatch.guarded_call(
+                    "pip_join.device", attempt, c
+                ),
                 grow, ceilings,
                 overflow_count=lambda o: int((o == OVERFLOW).sum()),
                 stage="pip_join",
@@ -1879,21 +1864,18 @@ def pip_join(
         )
 
         def attempt_banded(c):
-            def run_device():
-                o, nr = _JIT_JOIN(
-                    shifted, cells, chip_index,
-                    heavy_cap=c.get("heavy_cap", hcap),
-                    found_cap=c.get("found_cap", fcap), edge_eps2=eps2,
-                    writeback=writeback, lookup=lookup,
-                    probe=probe, convex_cap=c.get("convex_cap", ccap),
-                )
-                return np.array(o), np.array(nr)  # writable host copies
-
-            return _watchdog.guard("pip_join.device", run_device)
+            o, nr = _dispatch.jit_join()(
+                shifted, cells, chip_index,
+                heavy_cap=c.get("heavy_cap", hcap),
+                found_cap=c.get("found_cap", fcap), edge_eps2=eps2,
+                writeback=writeback, lookup=lookup,
+                probe=probe, convex_cap=c.get("convex_cap", ccap),
+            )
+            return np.array(o), np.array(nr)  # writable host copies
 
         (out, host_mask), _ = run_escalating(
-            lambda c: call_with_retry(
-                attempt_banded, c, label="pip_join.device"
+            lambda c: _dispatch.guarded_call(
+                "pip_join.device", attempt_banded, c
             ),
             grow, ceilings,
             overflow_count=lambda r: int((r[0] == OVERFLOW).sum()),
@@ -1920,7 +1902,7 @@ def pip_join(
                 # invalid alternates) escalate to the host oracle; the
                 # full point axis is never re-probed.
                 cap = min(_next_pow2(n_flag), chunk.shape[0])
-                src, _, _, _ = _JIT_COMPACT(flagged, cap=cap)
+                src, _, _, _ = _dispatch.jit_compact()(flagged, cap=cap)
                 alt = _assign_cells(
                     index_system, resolution, dev[src], "alt"
                 )
@@ -1940,7 +1922,9 @@ def pip_join(
                     # is unused)
                     nf2, nh2, _ = (
                         int(v)
-                        for v in np.asarray(_JIT_COUNTS(alt, chip_index))
+                        for v in np.asarray(
+                            _dispatch.jit_counts()(alt, chip_index)
+                        )
                     )
                     fcap2 = min(_next_pow2(nf2 + 1), cap)
                     hcap2 = (
@@ -1949,7 +1933,7 @@ def pip_join(
                         else None
                     )
                     r_alt = np.asarray(
-                        _JIT_JOIN(
+                        _dispatch.jit_join()(
                             shifted[src], alt, chip_index,
                             heavy_cap=hcap2, found_cap=fcap2,
                             lookup=lookup,

@@ -21,9 +21,8 @@ import numpy as np
 from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
-from ..runtime import faults as _faults
+from ..dispatch import core as _dispatch
 from ..runtime.errors import DegradedResult
-from ..runtime.retry import call_with_retry
 
 
 def _group_spans(cells_sorted: np.ndarray):
@@ -135,15 +134,16 @@ def intersects_join(
         b = rt.chips.take(rrows[need])
 
         def predicate():
-            _faults.maybe_fail("overlay.predicate")
             return np.asarray(st_intersects(a, b, backend=backend))
 
         # transient device failures retry with backoff; past the budget a
         # non-oracle backend degrades to the exact f64 host oracle (result
-        # flagged), an oracle run raises typed RetryExhausted
-        res = call_with_retry(
+        # flagged), an oracle run raises typed RetryExhausted — the
+        # watchdog/retry composition (and the "overlay.predicate" fault
+        # plan) lives in dispatch.guarded_call
+        res = _dispatch.guarded_call(
+            "overlay.predicate",
             predicate,
-            label="overlay.predicate",
             fallback=(
                 (lambda: np.asarray(st_intersects(a, b, backend="oracle")))
                 if backend != "oracle"

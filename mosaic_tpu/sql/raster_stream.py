@@ -30,14 +30,13 @@ import time
 
 import numpy as np
 
+from ..dispatch import core as _dispatch
 from ..obs import trace as _trace
 from ..runtime import (
     checkpoint as _checkpoint,
     telemetry as _telemetry,
-    watchdog as _watchdog,
 )
 from ..runtime.errors import RetryExhausted
-from ..runtime.retry import call_with_retry
 
 __all__ = ["RasterScanResult", "RasterStream"]
 
@@ -86,6 +85,7 @@ class RasterStream:
         compaction: str = "scatter",
         probe: str = "adaptive",
         convex_cap: "int | None" = None,
+        mesh=None,
     ):
         # the stream always folds on the f64-capable jnp lane — the
         # durable contract is bit-identity through kill/resume, and the
@@ -95,7 +95,7 @@ class RasterStream:
             index_system, resolution, chip_index=chip_index,
             found_cap=found_cap, heavy_cap=heavy_cap, lookup=lookup,
             compaction=compaction, probe=probe, convex_cap=convex_cap,
-            lane="fold",
+            lane="fold", mesh=mesh,
         )
         self.chip_index = chip_index
         self.index_system = index_system
@@ -260,13 +260,10 @@ class RasterStream:
                         )
 
                     try:
-                        cnt, s, mn, mx = call_with_retry(
-                            lambda: _watchdog.guard(
-                                "raster.zonal", dispatch,
-                                default_s=watchdog_default_s,
-                            ),
+                        cnt, s, mn, mx = _dispatch.guarded_call(
+                            "raster.zonal", dispatch,
+                            default_s=watchdog_default_s,
                             policy=retry_policy,
-                            label="raster.zonal",
                         )
                     except RetryExhausted as e:
                         if host is None:

@@ -44,33 +44,45 @@ addition is exact and associative across segment boundaries, and cell
 assignment is deterministic, so segmenting changes scheduling, never
 values (pinned by tests/test_stream_faults.py). Every blocking device
 operation sits under a `runtime/watchdog.py` deadline
-(``MOSAIC_WATCHDOG_*``), transient segment failures retry and then
+(``MOSAIC_WATCHDOG_*``) with transient retry — composed by
+`dispatch.guarded_call` at the ``stream.prefetch`` / ``stream.scan_step``
+/ ``stream.snapshot`` sites — segment failures past the retry budget
 degrade to the f64 host oracle (surfaced as ``metrics["degraded"]``,
 never vanishing into the fold), and :meth:`StreamJoin.admit` diverts
 poisoned input rows (NaN/Inf, out-of-CRS-bounds) into a quarantine
 buffer (`runtime/quarantine.py`) instead of the device fold.
+
+Dispatch-core unification (this PR's lane): the compiled program bundle
+(assign / join / scan / durable-segment executables) is built by
+:func:`build_stream_programs` and cached process-wide behind
+`dispatch.stream_programs`, keyed on the static spec — two StreamJoins
+over the same (system, resolution, caps, placement) share one set of
+compiles, and `dispatch.cache_stats` audits the population. ``mesh=``
+shards the scan data-parallel with the index replicated;
+``donate_ring=True`` donates the HBM ring to the loop.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..dispatch import core as _dispatch
 from ..obs import metrics as _metrics, trace as _trace
 from ..runtime import (
     checkpoint as _checkpoint,
     faults as _faults,
     quarantine as _quarantine,
     telemetry as _telemetry,
-    watchdog as _watchdog,
 )
 from ..runtime.errors import RetryExhausted
-from ..runtime.retry import call_with_retry
 from .join import (
     ChipIndex,
     host_join_with_cells,
@@ -108,7 +120,9 @@ def ring_from_host(batches) -> jax.Array:
             ring.block_until_ready()
             return ring
 
-        return _watchdog.guard("stream.prefetch", stage)
+        # watchdog only — ring staging has no retry budget of its own;
+        # the caller owns rebuild-vs-fail
+        return _dispatch.guarded_call("stream.prefetch", stage, retry=False)
 
 
 def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
@@ -127,7 +141,7 @@ def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
             ring.block_until_ready()
             return ring
 
-        return _watchdog.guard("stream.prefetch", stage)
+        return _dispatch.guarded_call("stream.prefetch", stage, retry=False)
 
 
 def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
@@ -192,6 +206,189 @@ class StreamResult:
     metrics: dict = dataclasses.field(default_factory=dict)
 
 
+@contextlib.contextmanager
+def _quiet_donation():
+    """Suppress the backend's not-donatable warning: on CPU donation is
+    a silent no-op by design (the bench records whether it applied via
+    ``ring.is_deleted()``), and the warning would fire once per run."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamPrograms:
+    """The compiled-executable bundle behind one StreamJoin spec.
+
+    Built by :func:`build_stream_programs` and cached process-wide by
+    `dispatch.stream_programs` — two StreamJoins over the same (system,
+    resolution, caps, placement) spec replay one compiled scan instead
+    of tracing their own. Every callable takes the ChipIndex as an
+    argument, so the bundle is index-agnostic (the compile signature is
+    the spec, not the data)."""
+
+    assign_eager: object  #: un-jitted assign for tiny host-side lookups
+    assign: object  #: jitted cell assignment (pts) -> int64 cells
+    join: object  #: jitted probe (pts, cells, index) -> rows
+    step: object  #: fused assign+join (pts, index) -> rows
+    step_stats: object  #: fused step, device-folded to (3,) stats
+    loop: object  #: jitted scan (ring, index, nb=, collect=)
+    donate_loop: object  #: ring-donating twin of ``loop`` (or None)
+    seg_loop: object  #: durable-segment scan (absolute batch indices)
+
+
+def build_stream_programs(
+    index_system,
+    resolution: int,
+    *,
+    dtype,
+    cell_dtype,
+    found_cap,
+    heavy_cap,
+    lookup,
+    compaction,
+    probe,
+    convex_cap,
+    prefetch,
+    donate_ring,
+    mesh,
+) -> StreamPrograms:
+    """Trace the full StreamJoin program set for one static spec.
+
+    Called through the bounded `dispatch.stream_programs` cache — never
+    directly. ``mesh`` (a 1-D ``dp`` mesh, or None) shards the probe
+    data-parallel with the ChipIndex replicated inside the scan body;
+    because each per-point result depends only on that point and the
+    replicated index, the sharded scan is bit-identical to the
+    single-device one. ``donate_ring`` additionally traces a donating
+    twin of the scan (``donate_argnums`` on the ring) so a sustained run
+    can release the K×B×2 HBM ring buffer to XLA instead of holding a
+    second copy across the loop — the donating twin is a separate
+    executable because warmup must not consume the caller's ring.
+    """
+
+    def assign(pts):
+        c = index_system.point_to_cell(pts.astype(cell_dtype), resolution)
+        return c.astype(jnp.int64)
+
+    def join_one(pts, cells, chip_index):
+        shifted = (pts - chip_index.border.shift).astype(dtype)
+        return pip_join_points(
+            shifted,
+            cells,
+            chip_index,
+            heavy_cap=heavy_cap,
+            found_cap=found_cap,
+            lookup=lookup,
+            compaction=compaction,
+            probe=probe,
+            convex_cap=convex_cap,
+        )
+
+    if mesh is None:
+        join = join_one
+    else:
+        join = _dispatch.sharded_pointwise(
+            join_one, mesh, check_rep=_dispatch.probe_check_rep(probe)
+        )
+
+    def loop(ring, chip_index, nb: int, collect: bool):
+        k = ring.shape[0]
+
+        def slot(i):
+            return jax.lax.dynamic_index_in_dim(
+                ring, i % k, axis=0, keepdims=False
+            )
+
+        if prefetch:
+
+            def body(carry, i):
+                acc, cells_cur = carry
+                # join batch i against the cells prefetched at i-1;
+                # assign batch i+1's cells in the SAME program so XLA
+                # overlaps the cell pipeline with the probe
+                out = join(slot(i), cells_cur, chip_index)
+                cells_next = assign(slot(i + 1))
+                return (acc + fold_stats(out), cells_next), (
+                    out if collect else None
+                )
+
+            carry0 = (jnp.zeros(3, jnp.int32), assign(ring[0]))
+        else:
+
+            def body(carry, i):
+                pts = slot(i)
+                out = join(pts, assign(pts), chip_index)
+                return carry + fold_stats(out), (
+                    out if collect else None
+                )
+
+            carry0 = jnp.zeros(3, jnp.int32)
+        carry, outs = jax.lax.scan(
+            body, carry0, jnp.arange(nb, dtype=jnp.int32)
+        )
+        acc = carry[0] if prefetch else carry
+        return acc, outs
+
+    def seg(ring, chip_index, i0, acc, cells, nb: int, collect: bool):
+        """One durable segment: the SAME scan body as ``loop`` over
+        absolute batch indices [i0, i0+nb). The carry crosses segments
+        through the host (snapshot), so the fold stays int32-add-exact
+        and cell prefetch deterministic — segmenting is invisible in
+        the final stats."""
+        k = ring.shape[0]
+
+        def slot(i):
+            return jax.lax.dynamic_index_in_dim(
+                ring, i % k, axis=0, keepdims=False
+            )
+
+        steps = i0 + jnp.arange(nb, dtype=jnp.int32)
+        if prefetch:
+
+            def body(carry, i):
+                a, cells_cur = carry
+                out = join(slot(i), cells_cur, chip_index)
+                cells_next = assign(slot(i + 1))
+                return (a + fold_stats(out), cells_next), (
+                    out if collect else None
+                )
+
+            (acc, cells), outs = jax.lax.scan(body, (acc, cells), steps)
+        else:
+
+            def body(a, i):
+                pts = slot(i)
+                out = join(pts, assign(pts), chip_index)
+                return a + fold_stats(out), (out if collect else None)
+
+            acc, outs = jax.lax.scan(body, acc, steps)
+        return acc, cells, outs
+
+    return StreamPrograms(
+        assign_eager=assign,
+        assign=jax.jit(assign),
+        join=jax.jit(join),
+        step=jax.jit(lambda pts, ix: join(pts, assign(pts), ix)),
+        # fused step + fold: benches time THIS (one (3,) pull forces
+        # completion; pulling the (N,) rows would measure the tunnel)
+        step_stats=jax.jit(
+            lambda pts, ix: fold_stats(join(pts, assign(pts), ix))
+        ),
+        loop=jax.jit(loop, static_argnames=("nb", "collect")),
+        donate_loop=(
+            jax.jit(
+                loop, static_argnames=("nb", "collect"), donate_argnums=(0,)
+            )
+            if donate_ring
+            else None
+        ),
+        seg_loop=jax.jit(seg, static_argnames=("nb", "collect")),
+    )
+
+
 class StreamJoin:
     """Compiled streaming pip-join over a resident ring.
 
@@ -203,6 +400,17 @@ class StreamJoin:
     deterministic, so joining batch i against cells computed one
     iteration early changes scheduling, never values — pinned by
     tests/test_stream.py.
+
+    The executables come from the unified dispatch core
+    (`dispatch.stream_programs`): one traced program bundle per static
+    spec, shared across StreamJoin instances and audited by
+    `dispatch.cache_stats`. ``mesh=`` (or the ``MOSAIC_MESH`` knob)
+    shards the probe data-parallel over a 1-D device mesh inside the
+    scan with the ChipIndex replicated — bit-identical at any device
+    count; the batch size must divide over the mesh. ``donate_ring=True``
+    lets ``run`` donate the ring buffer to the loop (``metrics
+    ["ring_donated"]`` reports whether the backend applied it — CPU
+    declines donation and keeps the copy).
     """
 
     def __init__(
@@ -219,11 +427,14 @@ class StreamJoin:
         prefetch: bool = True,
         probe: str = "scatter",
         convex_cap: int | None = None,
+        donate_ring: bool = False,
+        mesh=None,
     ):
         self.index = index
         self.index_system = index_system
         self.resolution = resolution
         self.prefetch = bool(prefetch)
+        self.donate_ring = bool(donate_ring)
         #: (ring fingerprint, report) of the last admission, if any
         self._last_quarantine: tuple | None = None
         dtype = index.border.verts.dtype
@@ -238,139 +449,68 @@ class StreamJoin:
             compaction = "scatter" if platform == "cpu" else "mxu"
         self.lookup, self.compaction = lookup, compaction
         self.found_cap, self.heavy_cap = found_cap, heavy_cap
-        # resolve the adaptive/force-lane knob HERE, before the value is
-        # closed over by the jitted scan (env changes cannot reach a
-        # compiled program; see join.resolve_probe_mode)
+        # resolve the adaptive/force-lane and mesh knobs HERE, before
+        # the values are closed over by the jitted scan (env changes
+        # cannot reach a compiled program; see join.resolve_probe_mode)
         probe = resolve_probe_mode(probe)
         self.probe, self.convex_cap = probe, convex_cap
+        self.mesh = _dispatch.resolve_mesh(mesh)
 
-        def assign(pts):
-            c = index_system.point_to_cell(
-                pts.astype(cell_dtype), resolution
-            )
-            return c.astype(jnp.int64)
-
+        progs = _dispatch.stream_programs(
+            index_system, resolution, dtype=dtype, cell_dtype=cell_dtype,
+            found_cap=found_cap, heavy_cap=heavy_cap, lookup=lookup,
+            compaction=compaction, probe=probe, convex_cap=convex_cap,
+            prefetch=self.prefetch, donate_ring=self.donate_ring,
+            mesh=self.mesh,
+        )
+        self._programs = progs
         # eager twin for tiny host-side lookups (park-point search): a
         # jitted call would recompile the whole cell pipeline per shape
-        self._assign_eager = assign
+        self._assign_eager = progs.assign_eager
+        self.assign = progs.assign
+        self.join = progs.join
+        self._step = progs.step
+        self._step_stats = progs.step_stats
+        self._loop = progs.loop
+        self._donate_loop = progs.donate_loop
+        self._seg_loop = progs.seg_loop
 
-        def join(pts, cells, chip_index):
-            shifted = (pts - chip_index.border.shift).astype(dtype)
-            return pip_join_points(
-                shifted,
-                cells,
-                chip_index,
-                heavy_cap=heavy_cap,
-                found_cap=found_cap,
-                lookup=lookup,
-                compaction=compaction,
-                probe=probe,
-                convex_cap=convex_cap,
+    def _check_batch(self, batch: int) -> None:
+        if self.mesh is not None and int(batch) % self.mesh.size:
+            raise ValueError(
+                f"stream batch {int(batch)} does not divide over the "
+                f"{self.mesh.size}-device mesh"
             )
-
-        self.assign = jax.jit(assign)
-        self.join = jax.jit(join)
-        self._step = jax.jit(lambda pts, ix: join(pts, assign(pts), ix))
-        # fused step + fold: benches time THIS (one (3,) pull forces
-        # completion; pulling the (N,) rows would measure the tunnel)
-        self._step_stats = jax.jit(
-            lambda pts, ix: fold_stats(join(pts, assign(pts), ix))
-        )
-
-        def loop(ring, chip_index, nb: int, collect: bool):
-            k = ring.shape[0]
-
-            def slot(i):
-                return jax.lax.dynamic_index_in_dim(
-                    ring, i % k, axis=0, keepdims=False
-                )
-
-            if self.prefetch:
-
-                def body(carry, i):
-                    acc, cells_cur = carry
-                    # join batch i against the cells prefetched at i-1;
-                    # assign batch i+1's cells in the SAME program so XLA
-                    # overlaps the cell pipeline with the probe
-                    out = join(slot(i), cells_cur, chip_index)
-                    cells_next = assign(slot(i + 1))
-                    return (acc + fold_stats(out), cells_next), (
-                        out if collect else None
-                    )
-
-                carry0 = (jnp.zeros(3, jnp.int32), assign(ring[0]))
-            else:
-
-                def body(carry, i):
-                    pts = slot(i)
-                    out = join(pts, assign(pts), chip_index)
-                    return carry + fold_stats(out), (
-                        out if collect else None
-                    )
-
-                carry0 = jnp.zeros(3, jnp.int32)
-            carry, outs = jax.lax.scan(
-                body, carry0, jnp.arange(nb, dtype=jnp.int32)
-            )
-            acc = carry[0] if self.prefetch else carry
-            return acc, outs
-
-        self._loop = jax.jit(loop, static_argnames=("nb", "collect"))
-
-        def seg(ring, chip_index, i0, acc, cells, nb: int, collect: bool):
-            """One durable segment: the SAME scan body as ``loop`` over
-            absolute batch indices [i0, i0+nb). The carry crosses
-            segments through the host (snapshot), so the fold stays
-            int32-add-exact and cell prefetch deterministic — segmenting
-            is invisible in the final stats."""
-            k = ring.shape[0]
-
-            def slot(i):
-                return jax.lax.dynamic_index_in_dim(
-                    ring, i % k, axis=0, keepdims=False
-                )
-
-            steps = i0 + jnp.arange(nb, dtype=jnp.int32)
-            if self.prefetch:
-
-                def body(carry, i):
-                    a, cells_cur = carry
-                    out = join(slot(i), cells_cur, chip_index)
-                    cells_next = assign(slot(i + 1))
-                    return (a + fold_stats(out), cells_next), (
-                        out if collect else None
-                    )
-
-                (acc, cells), outs = jax.lax.scan(body, (acc, cells), steps)
-            else:
-
-                def body(a, i):
-                    pts = slot(i)
-                    out = join(pts, assign(pts), chip_index)
-                    return a + fold_stats(out), (out if collect else None)
-
-                acc, outs = jax.lax.scan(body, acc, steps)
-            return acc, cells, outs
-
-        self._seg_loop = jax.jit(seg, static_argnames=("nb", "collect"))
 
     def step(self, pts: jax.Array) -> jax.Array:
         """Single fused batch (assign + join) — the single-batch-rate
         reference the sustained number is measured against."""
+        self._check_batch(pts.shape[0])
         return self._step(pts, self.index)
 
     def step_stats(self, pts: jax.Array) -> jax.Array:
         """Single fused batch, device-folded to (3,) stats."""
+        self._check_batch(pts.shape[0])
         return self._step_stats(pts, self.index)
 
     def compile(self, ring: jax.Array, n_batches: int, collect=False):
         """Warm the loop program (compile time must not pollute the
-        sustained measurement); emits a ``stream_stage`` compile event."""
+        sustained measurement); emits a ``stream_stage`` compile event.
+        With ``donate_ring`` the donating twin is warmed on a scratch
+        copy, so the caller's ring survives warmup intact."""
+        self._check_batch(ring.shape[1])
         with _telemetry.timed(
             "stream_stage", stage="compile", n_batches=n_batches,
-            prefetch=self.prefetch,
+            prefetch=self.prefetch, donate_ring=self.donate_ring,
         ):
-            acc, outs = self._loop(ring, self.index, n_batches, collect)
+            if self.donate_ring:
+                scratch = jnp.array(ring, copy=True)
+                with _quiet_donation():
+                    acc, outs = self._donate_loop(
+                        scratch, self.index, n_batches, collect
+                    )
+            else:
+                acc, outs = self._loop(ring, self.index, n_batches, collect)
             jax.block_until_ready(acc)
         return acc, outs
 
@@ -381,22 +521,41 @@ class StreamJoin:
 
         The whole stream is ONE dispatch (per-batch python dispatch over
         the tunnel measured 146 ms/batch for a 63 ms device step in r05);
-        completion is forced by pulling the (3,) fold.
+        completion is forced by pulling the (3,) fold. With
+        ``donate_ring`` the ring buffer is donated to the loop —
+        ``metrics["ring_donated"]`` records whether the backend applied
+        the donation (CPU declines; the ring then stays live).
         """
         k, batch = int(ring.shape[0]), int(ring.shape[1])
+        self._check_batch(batch)
+        donation = {}
+        ring_bytes = int(ring.nbytes)  # before the loop may delete it
         with _trace.span(
             "stream.run", n_batches=n_batches, batch=batch, ring_k=k,
         ):
             t0 = time.perf_counter()
-            acc, outs = self._loop(ring, self.index, n_batches, collect)
+            if self.donate_ring:
+                with _quiet_donation():
+                    acc, outs = self._donate_loop(
+                        ring, self.index, n_batches, collect
+                    )
+            else:
+                acc, outs = self._loop(ring, self.index, n_batches, collect)
             acc_np = np.asarray(acc)  # blocks: the loop's only host pull
             wall = time.perf_counter() - t0
             n_points = n_batches * batch
+            if self.donate_ring:
+                donation = {
+                    "donate_ring": True,
+                    "ring_donated": bool(ring.is_deleted()),
+                    "ring_bytes": ring_bytes,
+                }
             _telemetry.record(
                 "stream_stage", stage="join_loop",
                 seconds=round(wall, 6), n_batches=n_batches, batch=batch,
                 ring_k=k, prefetch=self.prefetch,
                 points_per_sec=round(n_points / max(wall, 1e-9), 1),
+                **donation,
             )
         return StreamResult(
             checksum=int(acc_np[0]),
@@ -409,6 +568,7 @@ class StreamJoin:
             points_per_sec=n_points / max(wall, 1e-9),
             prefetch=self.prefetch,
             outs=np.asarray(outs) if collect else None,
+            metrics=dict(donation),
         )
 
     def run_batched(self, ring: jax.Array, n_batches: int) -> StreamResult:
@@ -652,6 +812,7 @@ class StreamJoin:
         watchdog_default_s, retry_policy, trace_parent=None,
     ) -> StreamResult:
         k, batch = int(ring.shape[0]), int(ring.shape[1])
+        self._check_batch(batch)
         snapshot_every = max(1, snapshot_every)
         ring_np = np.asarray(ring)  # host twin: fingerprint + fallback
         ring_fp = _checkpoint.fingerprint(ring_np)
@@ -732,13 +893,10 @@ class StreamJoin:
 
             with _trace.span("stream.segment", step=step, n=seg_n):
                 try:
-                    a_np, cells_new, o_np = call_with_retry(
-                        lambda: _watchdog.guard(
-                            "stream.scan_step", dispatch,
-                            default_s=watchdog_default_s,
-                        ),
+                    a_np, cells_new, o_np = _dispatch.guarded_call(
+                        "stream.scan_step", dispatch,
+                        default_s=watchdog_default_s,
                         policy=retry_policy,
-                        label="stream.scan_step",
                     )
                     acc = np.asarray(a_np, np.int64)
                     cells = cells_new
@@ -772,13 +930,10 @@ class StreamJoin:
 
             with _trace.span("stream.snapshot", step=step):
                 try:
-                    call_with_retry(
-                        lambda: _watchdog.guard(
-                            "stream.snapshot", snap,
-                            default_s=watchdog_default_s,
-                        ),
+                    _dispatch.guarded_call(
+                        "stream.snapshot", snap,
+                        default_s=watchdog_default_s,
                         policy=retry_policy,
-                        label="stream.snapshot",
                     )
                     snapshots += 1
                 except RetryExhausted as e:

@@ -638,7 +638,7 @@ class TestPerfGate:
             assert key.split(".")[0] in (
                 "serve_stage", "stream_stage", "serve_request",
                 "recheck_narrow", "quarantine_stage", "snapshot_saved",
-                "probe_stage", "raster_stage",
+                "probe_stage", "raster_stage", "multichip_stage",
             ), key
 
 

@@ -379,9 +379,11 @@ def test_heavy_overflow_through_serve_scatter_back(index, batches):
     )
     try:
         clean = np.asarray(eng.join(pts))
-        caps0 = eng._caps
-        eng._caps = lambda bucket: (caps0(bucket)[0], 4, caps0(bucket)[2])
-        eng._signatures.clear()
+        caps0 = eng.core.caps
+        eng.core.caps = lambda bucket: (
+            caps0(bucket)[0], 4, caps0(bucket)[2]
+        )
+        eng.core.signatures.clear()
         over = np.asarray(eng.join(pts))
     finally:
         eng.shutdown() if hasattr(eng, "shutdown") else None

@@ -101,7 +101,7 @@ class TestCompileDiscipline:
 
     def test_cold_dispatch_counts_without_warmup(self, index, grid):
         with make_engine(index, grid) as eng:
-            eng._warmed = frozenset()  # arm the tripwire, skip warmup
+            eng.core.freeze()  # arm the tripwire, skip warmup
             eng.join(rand_points(np.random.default_rng(0), 10),
                      deadline_s=30.0)
             assert eng.metrics()["cold_compiles"] == 1

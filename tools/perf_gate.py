@@ -161,6 +161,12 @@ def main() -> int:
                     metavar="STAGE:FACTOR",
                     help="test knob: scale one fresh stage's seconds "
                     "(the CI negative lane proves the gate turns red)")
+    ap.add_argument("--stages-prefix", action="append", default=None,
+                    metavar="PREFIX",
+                    help="gate only golden stages under these key "
+                    "prefixes (repeatable) — a job that produces one "
+                    "lane's trail (multichip-smoke) gates its own pool "
+                    "without every other bench's trail on hand")
     args = ap.parse_args()
 
     from mosaic_tpu.obs import export
@@ -214,6 +220,17 @@ def main() -> int:
         golden = json.load(f)
     if args.tolerance is not None:
         golden["tolerance"] = args.tolerance
+    if args.stages_prefix:
+        pref = tuple(args.stages_prefix)
+        golden["stages"] = {
+            k: v for k, v in golden["stages"].items()
+            if k.startswith(pref)
+        }
+        if not golden["stages"]:
+            sys.stderr.write(
+                f"stages-prefix {pref} matches no golden stage\n"
+            )
+            return 2
     green, verdicts = evaluate(fresh, golden)
 
     for key, v in sorted(verdicts.items()):

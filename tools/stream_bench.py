@@ -60,6 +60,11 @@ def main() -> None:
                     help="HBM-resident ring slots (device-gen mode)")
     ap.add_argument("--device-gen", action="store_true",
                     help="pure-join ring mode (device-generated batches)")
+    ap.add_argument("--donate", action="store_true",
+                    help="A/B the donate_ring lane: rerun the join loop "
+                    "over a sacrificial ring copy with the ring buffer "
+                    "donated to XLA, and record the rate delta plus the "
+                    "bytes the copy-free loop keeps out of HBM")
     ap.add_argument("--no-ab", action="store_true",
                     help="skip the prefetch-off comparison compile")
     ap.add_argument("--fused", action="store_true",
@@ -298,6 +303,33 @@ def main() -> None:
                     res.checksum, res.matches, res.overflow
                 ):
                     detail["prefetch_mismatch"] = True  # never expected
+
+            # (4c) donation A/B: same loop with the ring buffer donated
+            # to XLA — the loop reuses the ring's HBM in place of a
+            # working copy, so the delta is the copy the non-donating
+            # loop pays (ring_bytes of extra peak HBM + the copy time)
+            if args.donate:
+                sj_d = StreamJoin(
+                    index, h3, RES, found_cap=fcap, heavy_cap=hcap,
+                    lookup=sj.lookup, compaction=sj.compaction,
+                    prefetch=True, donate_ring=True,
+                )
+                ring_d = jnp.array(ring, copy=True)  # sacrificial
+                sj_d.compile(ring_d, n_batches)
+                rd = sj_d.run(ring_d, n_batches)
+                d_rate = rd.n_points / max(rd.wall_s - rtt, 1e-9)
+                detail["donation"] = dict(
+                    {k: rd.metrics[k] for k in (
+                        "donate_ring", "ring_donated", "ring_bytes",
+                    ) if k in rd.metrics},
+                    points_per_sec=round(d_rate, 1),
+                    delta_vs_copy=round(d_rate - join_rate, 1),
+                    consistent_with_loop=bool(
+                        rd.checksum == res.checksum
+                        and rd.matches == res.matches
+                        and rd.overflow == res.overflow
+                    ),
+                )
 
             # (5) optional r05-comparable fused lane: gen inside the loop
             if args.fused:
