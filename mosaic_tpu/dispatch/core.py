@@ -570,7 +570,8 @@ class DispatchCore:
             )
         fcap, hcap, ccap = self.caps(bucket)
         sig = self.signature(bucket)
-        if sig not in self._signatures:
+        new_sig = sig not in self._signatures
+        if new_sig:
             self._signatures.add(sig)
             if self._warmed is not None:
                 self._cold_compiles += 1
@@ -581,31 +582,72 @@ class DispatchCore:
                         "dispatch_compile", bucket=bucket,
                         signatures=len(self._signatures),
                     )
-        dev = jnp.asarray(padded)
-        if self.cell_dtype is not None:
-            dev = dev.astype(self.cell_dtype)
-        # always the JITTED cell program (shared `cells_prog` lru, one
-        # compile per bucket, precompiled by warmup): the batch-path
-        # heuristic of going eager below 64k rows on CPU trades a
-        # one-off compile for a ~1000x slower dispatch — the right trade
-        # for a single cold batch, the wrong one on a hot path
-        cells = cells_prog(self.index_system, self.resolution, "cells")(dev)
-        shifted = jnp.asarray(padded - self._shift, dtype=self._dtype)
-        if self.mesh is None:
-            out = jit_join()(
-                shifted, cells, self.index,
-                heavy_cap=hcap, found_cap=fcap,
-                writeback=self.writeback, lookup=self.lookup,
-                probe=self.probe, convex_cap=ccap,
+        # a new signature means the program calls below will lower and
+        # compile: span the whole dispatch so the compile wall time gets
+        # an interval (class `compile`), stamped with the real XLA meter
+        # delta; warm replays skip the span entirely (no per-dispatch
+        # overhead, and the timeline never mistakes replay for compile)
+        comp_span = None
+        comp_c0 = None
+        if new_sig:
+            comp_c0 = backend_compiles()
+            comp_span = _trace.start_span(
+                "dispatch.compile", bucket=bucket,
+                signatures=len(self._signatures),
             )
-        else:
-            prog = sharded_join_prog(
-                self.mesh, writeback=self.writeback, lookup=self.lookup,
-                probe=self.probe, found_cap=fcap, heavy_cap=hcap,
-                convex_cap=ccap,
-            )
-            out = prog(shifted, cells, self.index)
-        return np.asarray(out)
+        try:
+            with _trace.span(
+                "dispatch.transfer.h2d", nbytes=int(padded.nbytes),
+                bucket=bucket,
+            ):
+                dev = jnp.asarray(padded)
+                if self.cell_dtype is not None:
+                    dev = dev.astype(self.cell_dtype)
+            # always the JITTED cell program (shared `cells_prog` lru,
+            # one compile per bucket, precompiled by warmup): the
+            # batch-path heuristic of going eager below 64k rows on CPU
+            # trades a one-off compile for a ~1000x slower dispatch —
+            # the right trade for a single cold batch, the wrong one on
+            # a hot path
+            cells = cells_prog(
+                self.index_system, self.resolution, "cells"
+            )(dev)
+            with _trace.span(
+                "dispatch.transfer.h2d", nbytes=int(padded.nbytes),
+                bucket=bucket, shifted=True,
+            ):
+                shifted = jnp.asarray(
+                    padded - self._shift, dtype=self._dtype
+                )
+            if self.mesh is None:
+                out = jit_join()(
+                    shifted, cells, self.index,
+                    heavy_cap=hcap, found_cap=fcap,
+                    writeback=self.writeback, lookup=self.lookup,
+                    probe=self.probe, convex_cap=ccap,
+                )
+            else:
+                prog = sharded_join_prog(
+                    self.mesh, writeback=self.writeback,
+                    lookup=self.lookup, probe=self.probe,
+                    found_cap=fcap, heavy_cap=hcap, convex_cap=ccap,
+                )
+                out = prog(shifted, cells, self.index)
+            # the result pull also blocks on device compute on async
+            # backends, so this upper-bounds the true D2H copy — still
+            # the only host-visible interval the copy has
+            with _trace.span(
+                "dispatch.transfer.d2h",
+                nbytes=int(getattr(out, "nbytes", 0)), bucket=bucket,
+            ):
+                res = np.asarray(out)
+            return res
+        finally:
+            if comp_span is not None:
+                c1 = backend_compiles()
+                if comp_c0 is not None and c1 is not None:
+                    comp_span.set(backend_compiles=c1 - comp_c0)
+                comp_span.end()
 
     def execute(self, points) -> np.ndarray:
         """Pad → dispatch → unpad (exact, unguarded)."""

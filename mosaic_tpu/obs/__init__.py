@@ -20,17 +20,28 @@ backward-compatible with) `runtime/telemetry.py`'s flat event trail:
   telemetry spine plus direct gauges where no event exists;
 - **exporters** (`obs/export.py`) — JSONL trails, Chrome trace-event
   JSON (Perfetto-loadable; the host-side complement of the xprof
-  device traces), Prometheus text exposition.
+  device traces), Prometheus text exposition;
+- **flight recorder** (`obs/recorder.py`) — an always-on bounded ring
+  over the spine (``MOSAIC_RECORDER_N``) that auto-dumps on typed
+  failures (RetryExhausted / StalledDeviceError / DegradedResult), so
+  post-hoc diagnosis never requires a re-run;
+- **timeline attribution** (`obs/timeline.py`) — interval
+  reconstruction from span ``start_mono``/``seconds``, per-track
+  gap/overlap, and the priority sweep that classifies lost wall time
+  into {transfer, compile, queue_wait, host_callback, device, idle}.
 
 Tools: `tools/trace_report.py` renders/diffs per-stage latency
-breakdowns from trails; `tools/perf_gate.py` is the CI regression gate
-over committed stage-share goldens (`tests/goldens/perf_gate.json`).
+breakdowns from trails; `tools/stall_report.py` decomposes a window of
+wall time into stall classes; `tools/perf_gate.py` is the CI
+regression gate over committed stage-share goldens
+(`tests/goldens/perf_gate.json`).
 
-Importing this package registers the tracer and the metric bridge with
-`runtime/telemetry.py`; until then the runtime pays nothing for either.
+Importing this package registers the tracer, the metric bridge, and
+the flight recorder with `runtime/telemetry.py`; until then the
+runtime pays nothing for any of them.
 """
 
-from . import export, metrics, trace
+from . import export, metrics, recorder, timeline, trace
 from .export import (
     chrome_trace,
     prometheus_text,
@@ -50,6 +61,7 @@ from .metrics import (
     histogram,
     snapshot,
 )
+from .recorder import RECORDER, FlightRecorder
 from .trace import (
     Span,
     SpanContext,
@@ -60,8 +72,11 @@ from .trace import (
 )
 
 metrics.install_bridge()
+recorder.install()
 
 __all__ = [
+    "FlightRecorder",
+    "RECORDER",
     "REGISTRY",
     "Counter",
     "Gauge",
@@ -79,9 +94,11 @@ __all__ = [
     "metrics",
     "prometheus_text",
     "read_trail",
+    "recorder",
     "snapshot",
     "span",
     "start_span",
+    "timeline",
     "trace",
     "trace_summary",
     "write_chrome_trace",

@@ -28,7 +28,16 @@ from __future__ import annotations
 
 import json
 
-from . import metrics as _metrics
+from . import metrics as _metrics, timeline as _timeline
+
+#: fixed Perfetto rows for classified intervals — stable tids well
+#: above the per-trace rows so the stall classes read as named tracks
+_CLASS_TIDS = {
+    "compile": 1001,
+    "transfer": 1002,
+    "queue_wait": 1003,
+    "host_callback": 1004,
+}
 
 #: span-event bookkeeping fields that are NOT user attributes
 _SPAN_FIELDS = (
@@ -86,14 +95,43 @@ def chrome_trace(events) -> dict:
     and every other timestamped event becomes a thread-scoped instant
     (``ph="i"``) on its trace's row (row 0 for untraced events), so
     retries and stalls appear inside the span that owns them.
+
+    Intervals the timeline layer classifies as a stall class (compile,
+    transfer, queue_wait, host_callback — see `obs/timeline.py`)
+    ADDITIONALLY land on a fixed named track per class (``mosaic:<cls>``
+    via ``thread_name`` metadata), so the Perfetto view answers the
+    overlap question at a glance: is the transfer row hidden under the
+    trace rows' compute, or serialized after it?
     """
     tids: dict = {}
     out = []
+    used_class_tids: dict = {}
 
     def tid_for(trace_id) -> int:
         if trace_id is None:
             return 0
         return tids.setdefault(trace_id, len(tids) + 1)
+
+    def class_track(e, name: str) -> None:
+        key = _timeline.event_key(e)
+        cls = _timeline.classify_key(key)
+        tid = _CLASS_TIDS.get(cls)
+        if tid is None:
+            return
+        iv = _timeline.interval_of(e)
+        if iv is None:
+            return
+        used_class_tids[tid] = cls
+        out.append({
+            "name": name,
+            "cat": "mosaic.timeline",
+            "ph": "X",
+            "ts": round(iv[0] * 1e6, 1),
+            "dur": round((iv[1] - iv[0]) * 1e6, 1),
+            "pid": 1,
+            "tid": tid,
+            "args": {"class": cls, "trace_id": e.get("trace_id")},
+        })
 
     for e in events:
         if e.get("event") == "span" and "seconds" in e:
@@ -116,6 +154,7 @@ def chrome_trace(events) -> dict:
                 "tid": tid_for(e.get("trace_id")),
                 "args": args,
             })
+            class_track(e, e.get("name", "span"))
         elif "ts_mono" in e:
             out.append({
                 "name": str(e.get("event", "event")),
@@ -130,6 +169,18 @@ def chrome_trace(events) -> dict:
                     if k not in ("event", "seq", "ts_mono")
                 },
             })
+            if "seconds" in e:
+                class_track(
+                    e, _timeline.event_key(e) or str(e.get("event"))
+                )
+    for tid, cls in sorted(used_class_tids.items()):
+        out.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": tid,
+            "args": {"name": f"mosaic:{cls}"},
+        })
     return {"traceEvents": out, "displayTimeUnit": "ms"}
 
 
@@ -182,12 +233,25 @@ def _sanitize(name: str) -> str:
     )
 
 
+def _escape_label_value(v) -> str:
+    """Escape a label VALUE per the Prometheus text exposition format:
+    backslash, double-quote, and line feed — in that order (escaping
+    the escapes first keeps the round trip lossless)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _labels_text(labels: dict, extra: dict | None = None) -> str:
     items = {**labels, **(extra or {})}
     if not items:
         return ""
     body = ",".join(
-        f'{_sanitize(str(k))}="{v}"' for k, v in sorted(items.items())
+        f'{_sanitize(str(k))}="{_escape_label_value(v)}"'
+        for k, v in sorted(items.items())
     )
     return "{" + body + "}"
 

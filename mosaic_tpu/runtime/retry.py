@@ -155,6 +155,10 @@ def call_with_retry(
             reason=f"{name}: retries exhausted ({last!r})"[:300],
             attempts=attempt,
         )
+    telemetry.record(
+        "retry_exhausted", label=name, attempts=attempt,
+        error=repr(last)[:200],
+    )
     raise RetryExhausted(
         f"{name}: transient-failure retry budget exhausted after "
         f"{attempt} attempts (last: {last!r})",

@@ -145,6 +145,17 @@ class MicroBatcher:
         if not live:
             return
 
+        # queue-wait interval per admitted request: submit stamp →
+        # batch formation (this instant); recorded flat (ts_mono -
+        # seconds recovers the interval) and stamped with the request's
+        # own trace ids so the wait lands inside its serve.request root
+        for req in live:
+            _telemetry.record(
+                "serve_stage", stage="queue_wait",
+                seconds=round(max(now - req.t_submit, 0.0), 6),
+                rows=req.n, **_req_ids(req),
+            )
+
         rows = sum(r.n for r in live)
         self.metrics["batches"] += 1
         self.metrics["batched_rows"] += rows

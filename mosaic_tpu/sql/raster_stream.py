@@ -293,16 +293,17 @@ class RasterStream:
                     "count": cnt_acc, "sum": sum_acc,
                     "min": min_acc, "max": max_acc,
                 }
-                try:
-                    _checkpoint.save_snapshot(
-                        run_dir, step, payload, meta
-                    )
-                    snapshots += 1
-                except Exception as e:  # lint: broad-except-ok (durability degrades — coarser resume point — but a sick disk must not kill the scan)
-                    _telemetry.record(
-                        "snapshot_skipped", run_dir=run_dir, step=step,
-                        error=repr(e)[:200],
-                    )
+                with _trace.span("raster.snapshot", step=step):
+                    try:
+                        _checkpoint.save_snapshot(
+                            run_dir, step, payload, meta
+                        )
+                        snapshots += 1
+                    except Exception as e:  # lint: broad-except-ok (durability degrades — coarser resume point — but a sick disk must not kill the scan)
+                        _telemetry.record(
+                            "snapshot_skipped", run_dir=run_dir,
+                            step=step, error=repr(e)[:200],
+                        )
         wall = time.perf_counter() - t0
         n_run = plan.ntiles - int(start_tile)
         px_run = n_run * th * tw

@@ -109,7 +109,9 @@ def ring_from_host(batches) -> jax.Array:
     ring. Blocks until the ring is staged (staging is not loop time).
     ``stream.prefetch`` is the fault/watchdog site: staging the next
     inputs is where a tunnel drop or hang surfaces in ring rebuilds."""
-    with _trace.span("stream.ring_build", source="host"), _telemetry.timed(
+    with _trace.span(
+        "stream.ring_build", source="host"
+    ) as sp, _telemetry.timed(
         "stream_stage", stage="ring_build", source="host"
     ):
 
@@ -122,7 +124,11 @@ def ring_from_host(batches) -> jax.Array:
 
         # watchdog only — ring staging has no retry budget of its own;
         # the caller owns rebuild-vs-fail
-        return _dispatch.guarded_call("stream.prefetch", stage, retry=False)
+        ring = _dispatch.guarded_call(
+            "stream.prefetch", stage, retry=False
+        )
+        sp.set(nbytes=int(getattr(ring, "nbytes", 0)))
+        return ring
 
 
 def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
@@ -130,7 +136,7 @@ def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
     distinct slots, stacked resident in HBM."""
     with _trace.span(
         "stream.ring_build", source="device_gen", k=k
-    ), _telemetry.timed(
+    ) as sp, _telemetry.timed(
         "stream_stage", stage="ring_build", source="device_gen", k=k
     ):
 
@@ -141,7 +147,11 @@ def ring_from_generator(gen, key: jax.Array, k: int) -> jax.Array:
             ring.block_until_ready()
             return ring
 
-        return _dispatch.guarded_call("stream.prefetch", stage, retry=False)
+        ring = _dispatch.guarded_call(
+            "stream.prefetch", stage, retry=False
+        )
+        sp.set(nbytes=int(getattr(ring, "nbytes", 0)))
+        return ring
 
 
 def hbm_peak(device=None, fallback_arrays=()) -> tuple[int, str]:
@@ -921,7 +931,14 @@ class StreamJoin:
             def snap():
                 payload = {"acc": _wrap_i32(acc).astype(np.int32)}
                 if self.prefetch:
-                    payload["cells"] = np.asarray(cells)  # snapshot D2H
+                    # a TRUE D2H interval: the segment's compute is
+                    # already forced complete by the acc pull above, so
+                    # this measures the copy, not hidden device work
+                    with _trace.span(
+                        "dispatch.transfer.d2h", site="stream.snapshot",
+                        nbytes=int(getattr(cells, "nbytes", 0)),
+                    ):
+                        payload["cells"] = np.asarray(cells)
                 for key, val in (extra_arrays or {}).items():
                     payload[f"x_{key}"] = np.asarray(val)
                 return _checkpoint.save_snapshot(

@@ -6,6 +6,7 @@ single-trace contract, and the perf regression gate."""
 
 import json
 import logging
+import re
 import sys
 import threading
 import time
@@ -748,3 +749,119 @@ class TestTraceReport:
         assert trace_report.stage_key(
             {"stage_key": "x", "seconds": 1.0}
         ) == "x"
+
+
+# ------------------------------------- prometheus label-value escaping
+
+
+_LABEL_RE = re.compile(r'="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    # inverse of the exposition-format escaping, applied left to right
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"\\": "\\", '"': '"', "n": "\n"}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+class TestPrometheusLabelEscaping:
+    HOSTILE = [
+        'a\\b"c\nd',                      # all three escapes at once
+        "C:\\temp\\trail.jsonl",          # windows path (backslashes)
+        'say "hi"',                       # embedded quotes
+        "line1\nline2",                   # embedded newline
+        "\\n",                            # literal backslash-n, NOT \n
+        'trailing\\',                     # trailing backslash
+    ]
+
+    @pytest.mark.parametrize("value", HOSTILE)
+    def test_hostile_value_round_trips(self, value):
+        reg = obs_metrics.Registry()
+        reg.counter("hostile").inc(site=value)
+        snap = {n: m.snapshot() for n, m in reg._metrics.items()}
+        text = obs.prometheus_text(snap)
+        line = next(
+            ln for ln in text.splitlines() if ln.startswith("hostile{")
+        )
+        # exactly one series line, one value capture, lossless inverse
+        (escaped,) = _LABEL_RE.findall(line)
+        assert "\n" not in line
+        assert _unescape_label(escaped) == value
+
+    def test_distinct_hostile_values_stay_distinct(self):
+        # the raw f-string rendering collapsed 'a\nb' and 'a\\nb' into
+        # ambiguous text; escaped rendering must keep them apart
+        reg = obs_metrics.Registry()
+        reg.counter("h2").inc(site="a\nb")
+        reg.counter("h2").inc(2, site="a\\nb")
+        snap = {n: m.snapshot() for n, m in reg._metrics.items()}
+        text = obs.prometheus_text(snap)
+        lines = [
+            ln for ln in text.splitlines() if ln.startswith("h2{")
+        ]
+        assert len(lines) == 2
+        vals = {
+            _unescape_label(_LABEL_RE.findall(ln)[0]) for ln in lines
+        }
+        assert vals == {"a\nb", "a\\nb"}
+
+
+# ------------------------------------------ chrome trace class tracks
+
+
+class TestChromeTraceClassTracks:
+    def test_classified_spans_land_on_named_tracks(self):
+        events = [
+            _span_evt(
+                "dispatch.transfer.h2d", "t1", "a1", None,
+                seconds=0.1, nbytes=4096,
+            ),
+            _span_evt("stream.segment", "t1", "b2", None, seconds=0.5),
+            {"event": "serve_stage", "stage": "queue_wait",
+             "seconds": 0.02, "ts_mono": 100.5, "seq": 3,
+             "trace_id": "t1"},
+        ]
+        doc = obs.chrome_trace(events)
+        evs = doc["traceEvents"]
+        track = [e for e in evs if e.get("cat") == "mosaic.timeline"]
+        # transfer span + queue_wait interval get track rows; the
+        # device-class segment stays on its trace row only
+        assert {e["args"]["class"] for e in track} == {
+            "transfer", "queue_wait",
+        }
+        xfer = next(e for e in track if e["args"]["class"] == "transfer")
+        assert xfer["ph"] == "X" and xfer["tid"] == 1002
+        qw = next(e for e in track if e["args"]["class"] == "queue_wait")
+        assert qw["ph"] == "X" and qw["tid"] == 1003
+        # the flat interval is anchored at ts_mono - seconds
+        assert qw["ts"] == pytest.approx((100.5 - 0.02) * 1e6)
+        names = {
+            (e["tid"], e["args"]["name"]) for e in evs
+            if e.get("ph") == "M" and e["name"] == "thread_name"
+        }
+        assert (1002, "mosaic:transfer") in names
+        assert (1003, "mosaic:queue_wait") in names
+        # the original trace rows are still intact alongside
+        assert any(
+            e["ph"] == "X" and e.get("cat") == "mosaic"
+            and e["name"] == "dispatch.transfer.h2d"
+            for e in evs
+        )
+        json.loads(json.dumps(doc))
+
+    def test_unclassified_trails_emit_no_tracks(self):
+        doc = obs.chrome_trace(
+            [_span_evt("custom.thing", "t1", "a1", None)]
+        )
+        assert not [
+            e for e in doc["traceEvents"]
+            if e.get("cat") == "mosaic.timeline" or e.get("ph") == "M"
+        ]

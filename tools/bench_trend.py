@@ -1,0 +1,210 @@
+"""Consolidate committed bench artifacts into one perf trajectory.
+
+Every PR commits its bench results (`BENCH_r*.json`, `STREAM_*.json`,
+`MULTICHIP_r*.json`, `RASTER_r*.json`, `BENCH_TPU_LIVE.json`) but
+nothing reads them as a SERIES — the trajectory question ("did the
+multichip lane actually get faster across PRs 6→7?") needs manual
+spelunking. This tool scans the repo root, groups artifacts into lanes
+(filename stem with the ``_rNN`` round suffix stripped), extracts each
+round's headline ``{metric, value, unit}``, and writes ``TREND.json``
+plus (``--write-readme``) a markdown table between the
+``<!-- trend:begin -->`` / ``<!-- trend:end -->`` markers in README.md.
+
+Artifact shapes handled:
+- bare bench lines: ``{"metric", "value", "unit", "detail"}``
+  (STREAM/MULTICHIP/RASTER/TPU_LIVE);
+- driver wrappers: ``{"n", "cmd", "rc", "tail", "parsed"}`` where
+  ``parsed`` is the bench line when the run's last stdout line parsed
+  (``n`` is the round); unparseable/failed rounds are listed under
+  ``skipped`` — a gap in the series is information, not noise.
+
+The LAST stdout line is one JSON object (the repo-wide bench
+contract): the TREND document itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PATTERNS = (
+    "BENCH_r*.json",
+    "BENCH_TPU_LIVE.json",
+    "STREAM_*.json",
+    "MULTICHIP_r*.json",
+    "RASTER_r*.json",
+)
+
+_ROUND_RE = re.compile(r"_r(\d+)$")
+
+
+def _lane_and_round(stem: str, doc: dict) -> tuple[str, object]:
+    m = _ROUND_RE.search(stem)
+    if m:
+        return stem[: m.start()], int(m.group(1))
+    if isinstance(doc.get("n"), int):
+        return stem, doc["n"]
+    return stem, "live" if "LIVE" in stem else None
+
+
+def _headline(doc: dict) -> dict | None:
+    """The ``{metric, value, unit}`` of one artifact, or None."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc or "tail" in doc:  # driver wrapper
+        doc = doc.get("parsed")
+        if not isinstance(doc, dict):
+            return None
+    if not isinstance(doc.get("value"), (int, float)):
+        return None
+    return {
+        "metric": doc.get("metric"),
+        "value": doc["value"],
+        "unit": doc.get("unit"),
+    }
+
+
+def collect(root: str) -> dict:
+    lanes: dict = {}
+    skipped: list = []
+    seen = set()
+    for pat in PATTERNS:
+        for path in sorted(glob.glob(os.path.join(root, pat))):
+            if path in seen:
+                continue
+            seen.add(path)
+            fname = os.path.basename(path)
+            stem = fname[: -len(".json")]
+            try:
+                with open(path, encoding="utf-8") as f:
+                    doc = json.load(f)
+            except (OSError, ValueError) as e:
+                skipped.append({"file": fname, "reason": repr(e)[:120]})
+                continue
+            lane, rnd = _lane_and_round(stem, doc)
+            head = _headline(doc)
+            if head is None:
+                skipped.append({
+                    "file": fname,
+                    "reason": "no parseable {metric,value} headline "
+                              f"(rc={doc.get('rc')})"
+                    if isinstance(doc, dict) else "not an object",
+                })
+                continue
+            lanes.setdefault(lane, []).append({
+                "round": rnd, "file": fname, **head,
+            })
+    out = {}
+    for lane, pts in sorted(lanes.items()):
+        pts.sort(
+            key=lambda p: (
+                p["round"] if isinstance(p["round"], int) else 1 << 30
+            )
+        )
+        first, latest = pts[0], pts[-1]
+        out[lane] = {
+            "metric": latest["metric"],
+            "unit": latest["unit"],
+            "points": pts,
+            "first": first["value"],
+            "latest": latest["value"],
+            "ratio": (
+                round(latest["value"] / first["value"], 3)
+                if first["value"] else None
+            ),
+        }
+    return {
+        "metric": "bench_trend",
+        "lanes": out,
+        "skipped": skipped,
+        "n_artifacts": sum(len(v["points"]) for v in out.values()),
+    }
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, (int, float)) and abs(v) >= 1000:
+        return f"{v:,.0f}"
+    return f"{v}"
+
+
+def readme_table(trend: dict) -> str:
+    lines = [
+        "| lane | metric | unit | first | latest | Δ× | rounds |",
+        "|---|---|---|---:|---:|---:|---|",
+    ]
+    for lane, d in trend["lanes"].items():
+        rounds = ", ".join(
+            f"r{p['round']:02d}" if isinstance(p["round"], int)
+            else str(p["round"])
+            for p in d["points"]
+        )
+        ratio = f"{d['ratio']}×" if d["ratio"] is not None else "—"
+        lines.append(
+            f"| {lane} | {d['metric']} | {d['unit']} "
+            f"| {_fmt(d['first'])} | {_fmt(d['latest'])} "
+            f"| {ratio} | {rounds} |"
+        )
+    return "\n".join(lines)
+
+
+def update_readme(path: str, table: str) -> bool:
+    begin, end = "<!-- trend:begin -->", "<!-- trend:end -->"
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    if begin not in text or end not in text:
+        return False
+    head, rest = text.split(begin, 1)
+    _, tail = rest.split(end, 1)
+    new = f"{head}{begin}\n{table}\n{end}{tail}"
+    if new != text:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(new)
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--root", default=REPO)
+    ap.add_argument(
+        "--out", default=None,
+        help="write TREND.json here (default <root>/TREND.json; "
+             "'-' skips the file)",
+    )
+    ap.add_argument(
+        "--write-readme", action="store_true",
+        help="refresh the trend table between the README markers",
+    )
+    args = ap.parse_args()
+
+    trend = collect(args.root)
+    out = args.out or os.path.join(args.root, "TREND.json")
+    if out != "-":
+        with open(out, "w", encoding="utf-8") as f:
+            json.dump(trend, f, indent=1, sort_keys=False)
+            f.write("\n")
+    print(readme_table(trend), file=sys.stderr)
+    for s in trend["skipped"]:
+        print(f"skipped {s['file']}: {s['reason']}", file=sys.stderr)
+    if args.write_readme:
+        ok = update_readme(
+            os.path.join(args.root, "README.md"), readme_table(trend)
+        )
+        print(
+            "README trend table "
+            + ("updated" if ok else "markers missing — NOT updated"),
+            file=sys.stderr,
+        )
+    print(json.dumps(trend))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,6 +212,13 @@ def main() -> None:
         single_s = max(min(reps) - rtt, min(reps) * 0.2, 1e-9)
         single_rate = batch / single_s
         detail["single_batch_rate"] = round(single_rate, 1)
+        # in the trail too, so stall_report can decompose sustained-vs-
+        # single loss from the trail alone (artifacts embed stages)
+        telemetry.record(
+            "stream_stage", stage="single_batch",
+            seconds=round(single_s, 6), batch=batch,
+            points_per_sec=round(single_rate, 1),
+        )
 
         if args.device_gen:
             detail["mode"] = "device-gen-ring"
