@@ -1,0 +1,115 @@
+"""Geo-expression compiler: typed op-trees fused into one device
+program per dispatch signature.
+
+The reference surface is ~120 Catalyst expressions run through Spark's
+whole-stage codegen; this package is the same move at our scale — a
+small algebra over per-pixel band values (`expr.ast`), a lowering that
+fuses the whole tree INTO the segment-reduced zonal fold so "NDVI, mask
+clouds, zonal-mean by district" is a single launch per tile bucket
+(`expr.compile`), execution through the dispatch core's guarded path so
+watchdog/retry/host-oracle degradation come for free (`expr.eval`), and
+a numpy-f64 interpreter of the same tree that device results must match
+bit for bit (`expr.host_oracle`).
+
+Entry points most callers want::
+
+    from mosaic_tpu import expr
+
+    e = expr.ndvi(nir=2, red=1).mask_where(expr.band(3) < 0.5)
+    result = engine.map(e.zonal(by="zones"), raster)
+"""
+
+from .ast import (  # noqa: F401
+    Band,
+    BinOp,
+    BoolOp,
+    CellOf,
+    Compare,
+    Const,
+    Expr,
+    InZone,
+    Join,
+    MaskWhere,
+    Not,
+    Where,
+    Zonal,
+    ZoneData,
+    band,
+    bands_of,
+    cell_of,
+    const,
+    in_zone,
+    mask_where,
+    ndvi,
+    norm_diff,
+    structure_key,
+    terminal_of,
+    tree_hash,
+    uses_cells,
+    uses_zones,
+    validate,
+    where,
+    zone_data,
+)
+from .compile import (  # noqa: F401
+    cold_compiles,
+    freeze,
+    pixel_program,
+    run_zonal,
+    signature_of,
+    signatures,
+    zonal_program,
+)
+from .eval import map_join, map_pixels, map_zonal, warmup_expr  # noqa: F401
+from .host_oracle import (  # noqa: F401
+    host_expr_tile_partial,
+    host_expr_zonal_oracle,
+    interpret,
+)
+
+__all__ = [
+    "Band",
+    "BinOp",
+    "BoolOp",
+    "CellOf",
+    "Compare",
+    "Const",
+    "Expr",
+    "InZone",
+    "Join",
+    "MaskWhere",
+    "Not",
+    "Where",
+    "Zonal",
+    "ZoneData",
+    "band",
+    "bands_of",
+    "cell_of",
+    "cold_compiles",
+    "const",
+    "freeze",
+    "host_expr_tile_partial",
+    "host_expr_zonal_oracle",
+    "in_zone",
+    "interpret",
+    "map_join",
+    "map_pixels",
+    "map_zonal",
+    "mask_where",
+    "ndvi",
+    "norm_diff",
+    "pixel_program",
+    "run_zonal",
+    "signature_of",
+    "signatures",
+    "structure_key",
+    "terminal_of",
+    "tree_hash",
+    "uses_cells",
+    "uses_zones",
+    "validate",
+    "warmup_expr",
+    "where",
+    "zonal_program",
+    "zone_data",
+]

@@ -1,0 +1,281 @@
+"""numpy-f64 interpreter of expression trees — the bit-identity bar.
+
+Every fused device result is required to match this interpreter bit for
+bit (the project's standing oracle contract): :func:`interpret` walks
+the SAME tree with the SAME operation order and the SAME mask-
+propagation rule as the device lowering in `expr.compile`, in plain
+numpy f64 — elementwise IEEE ops agree bit-exactly between XLA CPU and
+numpy, and the affine center/cell/membership machinery reuses the
+existing per-layer oracles (`raster.zonal.host_tile_centers`,
+``index_system.point_to_cell``, `sql.join.host_join`) that the zonal
+tests already pin against the device.
+
+Two consumers:
+
+- :func:`host_expr_zonal_oracle` — the full unfused twin of
+  `expr.eval.map_zonal` (same tile decomposition, per-tile sequential
+  f64 fold, row-major left-fold merge).
+- :func:`host_expr_tile_partial` — ONE tile's partial, the degradation
+  twin `eval` substitutes when a tile's device dispatch exhausts its
+  retry budget; being bit-identical, a degraded tile does not perturb
+  the fold.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..raster.tiles import plan_tiles
+from ..raster.zonal import (
+    ZonalResult,
+    _oracle_fold,
+    _result_from_dict,
+    host_tile_centers,
+)
+from ..sql.join import host_join
+from . import ast
+
+__all__ = [
+    "host_expr_tile_partial",
+    "host_expr_zonal_oracle",
+    "host_fold_partial",
+    "interpret",
+]
+
+_BIN = {
+    "add": np.add,
+    "sub": np.subtract,
+    "mul": np.multiply,
+    "div": np.divide,
+    "min": np.minimum,
+    "max": np.maximum,
+}
+_CMP = {
+    "lt": np.less,
+    "le": np.less_equal,
+    "gt": np.greater,
+    "ge": np.greater_equal,
+    "eq": np.equal,
+    "ne": np.not_equal,
+}
+
+
+class HostCtx:
+    """Interpretation context for one tile: ``vals``/``mask`` are the
+    (B, P) f64/bool stack rows (row order = sorted band indices, same
+    layout the device programs consume), ``cells`` the (P,) i64 cell
+    ids, ``seg`` the (P,) zone row per pixel (-1 outside every zone)."""
+
+    def __init__(self, vals, mask, rows, cells=None, seg=None):
+        self.vals = vals
+        self.mask = mask
+        self.rows = rows
+        self.cells = cells
+        self.seg = seg
+
+
+def interpret(node: ast.Expr, ctx: HostCtx):
+    """→ (value, valid) numpy arrays — the f64 mirror of the device
+    lowering, op for op (div by zero runs under errstate-ignore so the
+    oracle reaches the same inf/NaN bits the device produces)."""
+    true = np.True_
+    if isinstance(node, ast.Band):
+        r = ctx.rows[node.index]
+        return ctx.vals[r], ctx.mask[r]
+    if isinstance(node, ast.Const):
+        return np.float64(node.value), true
+    if isinstance(node, (ast.BinOp, ast.Compare)):
+        av, am = interpret(node.a, ctx)
+        bv, bm = interpret(node.b, ctx)
+        fn = _BIN[node.op] if isinstance(node, ast.BinOp) else _CMP[node.op]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return fn(av, bv), am & bm
+    if isinstance(node, ast.BoolOp):
+        av, am = interpret(node.a, ctx)
+        bv, bm = interpret(node.b, ctx)
+        return (av & bv) if node.op == "and" else (av | bv), am & bm
+    if isinstance(node, ast.Not):
+        av, am = interpret(node.a, ctx)
+        return ~av, am
+    if isinstance(node, ast.Where):
+        cv, cm = interpret(node.cond, ctx)
+        av, am = interpret(node.a, ctx)
+        bv, bm = interpret(node.b, ctx)
+        return np.where(cv, av, bv), cm & np.where(cv, am, bm)
+    if isinstance(node, ast.MaskWhere):
+        vv, vm = interpret(node.value, ctx)
+        cv, cm = interpret(node.cond, ctx)
+        return vv, vm & cm & cv
+    if isinstance(node, ast.CellOf):
+        return ctx.cells, true
+    if isinstance(node, ast.InZone):
+        return ctx.seg >= 0, true
+    if isinstance(node, ast.ZoneData):
+        table = np.asarray(node.values, np.float64)
+        inside = ctx.seg >= 0
+        idx = np.where(inside, ctx.seg, 0)
+        return np.where(
+            inside, table[idx], np.float64(node.fill)
+        ), true
+    raise TypeError(
+        f"cannot interpret {type(node).__name__} — peel the terminal "
+        "first"
+    )
+
+
+def _stack_band_views(raster, plan, bands):
+    """Per-tile generator of the multi-band twin of
+    `raster.zonal._host_tile_views`: yields (t, (B, P) f64 values,
+    (B, P) bool mask, (P, 2) f64 centers) in row-major tile order."""
+    th, tw = plan.shape
+    h, w = plan.raster_shape
+    full = [
+        (raster.band(b).values.astype(np.float64), raster.band(b).mask)
+        for b in bands
+    ]
+    for t, (r0, c0) in enumerate(plan.origins):
+        vals = np.zeros((len(bands), th, tw), np.float64)
+        mask = np.zeros((len(bands), th, tw), bool)
+        r1 = min(int(r0) + th, h)
+        c1 = min(int(c0) + tw, w)
+        for i, (vf, mf) in enumerate(full):
+            sub = vf[int(r0):r1, int(c0):c1]
+            vals[i, : sub.shape[0], : sub.shape[1]] = sub
+            mask[i, : sub.shape[0], : sub.shape[1]] = mf[
+                int(r0):r1, int(c0):c1
+            ]
+        vals[~mask] = 0
+        yield (
+            t,
+            vals.reshape(len(bands), -1),
+            mask.reshape(len(bands), -1),
+            host_tile_centers(plan, t),
+        )
+
+
+def host_fold_partial(vals, valid, seg, num_segments: int):
+    """One tile's sequential f64 fold into dense (S,) partials — the
+    host twin of the fused program's masked segment fold, row-major
+    pixel order (the order XLA's CPU scatter applies updates in)."""
+    g = int(num_segments)
+    cnt = np.zeros(g, np.int64)
+    s = np.zeros(g, np.float64)
+    mn = np.full(g, np.inf)
+    mx = np.full(g, -np.inf)
+    seg = np.asarray(seg)
+    valid = np.asarray(valid, bool)
+    for gg, ok, v in zip(seg, valid, np.asarray(vals, np.float64)):
+        if ok and gg >= 0:
+            cnt[gg] += 1
+            s[gg] += v
+            mn[gg] = min(mn[gg], v)
+            mx[gg] = max(mx[gg], v)
+    return cnt, s, mn, mx
+
+
+def _tile_ctx(raster_ctx, value, pts, index_system, resolution, host):
+    """Fill the cells/seg members a tree actually uses — membership via
+    the exact f64 host join, cells via the host-side point_to_cell."""
+    import jax.numpy as jnp
+
+    cells = None
+    seg = None
+    if ast.uses_cells(value):
+        cells = np.asarray(
+            index_system.point_to_cell(jnp.asarray(pts), resolution)
+        ).astype(np.int64)
+    if host is not None:
+        seg = np.asarray(
+            host_join(pts, host, index_system, resolution)
+        )
+    raster_ctx.cells = cells
+    raster_ctx.seg = seg
+    return raster_ctx
+
+
+def host_expr_tile_partial(
+    value: ast.Expr, vals, mask, pts, *,
+    index_system, resolution, host, num_segments: int, by: str,
+):
+    """ONE tile's zone/grid partial on the host — the degradation twin
+    of the fused device tile dispatch. ``vals``/``mask`` are the (B, P)
+    stack; returns dense (S,) (count, sum, min, max) for ``by="zones"``
+    (S = num_zones) or a {cell_id: [c, s, mn, mx]} dict for grid."""
+    import jax.numpy as jnp
+
+    rows = _band_rows(value)
+    ctx = HostCtx(np.asarray(vals, np.float64), np.asarray(mask, bool),
+                  rows)
+    _tile_ctx(ctx, value, pts, index_system, resolution, host)
+    v, m = interpret(value, ctx)
+    p = ctx.mask.shape[-1] if ctx.mask.size else len(pts)
+    v = np.broadcast_to(np.asarray(v, np.float64), (p,))
+    m = np.broadcast_to(np.asarray(m, bool), (p,))
+    if by == "zones":
+        seg = ctx.seg
+        if seg is None:
+            seg = np.asarray(
+                host_join(pts, host, index_system, resolution)
+            )
+        return host_fold_partial(v, m, seg, num_segments)
+    cells = np.asarray(
+        index_system.point_to_cell(jnp.asarray(pts), resolution)
+    ).astype(np.int64)
+    acc: dict = {}
+    seg = np.where(m, cells, -1)
+    _oracle_fold(acc, seg, v)
+    return acc
+
+
+def _band_rows(value: ast.Expr) -> dict:
+    return {b: r for r, b in enumerate(ast.bands_of(value))}
+
+
+def host_expr_zonal_oracle(
+    raster, expr: ast.Expr, *, index_system, resolution,
+    chip_index=None, tile=None, by: "str | None" = None,
+) -> ZonalResult:
+    """Pure-host f64 twin of `expr.eval.map_zonal`: interpret the same
+    tree per tile, resolve membership through the exact f64 host join
+    (zones) or point_to_cell (grid), fold sequentially per tile, merge
+    with the same row-major left fold. Device results must match this
+    bit for bit."""
+    value, kind, term_by, _stats = ast.terminal_of(expr)
+    if kind != "zonal":
+        raise ValueError("host_expr_zonal_oracle folds zonal terminals")
+    by = by or term_by
+    host = None
+    if chip_index is not None:
+        host = getattr(chip_index, "host", None)
+        if host is None and by == "zones":
+            raise ValueError("chip_index carries no HostRecheck tables")
+    ast.validate(
+        expr, raster.num_bands, has_zones=chip_index is not None, by=by,
+    )
+    plan = plan_tiles(raster, tile)
+    bands = ast.bands_of(value)
+    rows = _band_rows(value)
+    acc: dict = {}
+    for _t, vals, mask, pts in _stack_band_views(raster, plan, bands):
+        ctx = HostCtx(vals, mask, rows)
+        _tile_ctx(ctx, value, pts, index_system, resolution,
+                  host if by == "zones" else None)
+        if by == "zones" and ctx.seg is None:
+            ctx.seg = np.asarray(
+                host_join(pts, host, index_system, resolution)
+            )
+        v, m = interpret(value, ctx)
+        p = pts.shape[0]
+        v = np.broadcast_to(np.asarray(v, np.float64), (p,))
+        m = np.broadcast_to(np.asarray(m, bool), (p,))
+        if by == "zones":
+            key = ctx.seg
+        else:
+            import jax.numpy as jnp
+
+            key = np.asarray(
+                index_system.point_to_cell(jnp.asarray(pts), resolution)
+            ).astype(np.int64)
+        seg = np.where(m & (key >= 0), key, -1)
+        _oracle_fold(acc, seg, v)
+    return _result_from_dict(acc, band=0)

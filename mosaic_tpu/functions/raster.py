@@ -30,6 +30,7 @@ __all__ = [
     "rst_worldtorastercoordx", "rst_worldtorastercoordy", "rst_retile",
     "rst_rastertogridavg", "rst_rastertogridmin", "rst_rastertogridmax",
     "rst_rastertogridmedian", "rst_rastertogridcount",
+    "rst_mapbands", "rst_ndvi",
 ]
 
 
@@ -291,3 +292,58 @@ def rst_rastertogridmedian(col, resolution, index=None, raster_srid=None):
 
 def rst_rastertogridcount(col, resolution, index=None, raster_srid=None):
     return _raster_to_grid(col, resolution, index, "count", raster_srid)
+
+
+# ------------------------------------------------------- expression layer
+
+
+def rst_mapbands(col, expr, tile=None, index=None,
+                 resolution=None) -> list[Raster]:
+    """Evaluate a per-pixel expression tree (`mosaic_tpu.expr`) over
+    each raster: one fused device program per tile bucket runs the whole
+    band-math pipeline in a single launch. Returns single-band f64
+    rasters (same geotransform/SRID) with NaN nodata at invalid pixels —
+    invalid means outside the pad∧nodata∧NaN tile mask or masked by the
+    expression's own ``mask_where``. Trees using ``cell_of()`` need a
+    resolution (and an index — session context by default)."""
+    from ..expr import map_pixels
+    from ..expr.ast import uses_cells
+
+    index_system = None
+    if uses_cells(expr):
+        if index is None:
+            from ..context import current_context
+
+            index = current_context().index_system
+        if resolution is None:
+            raise ValueError(
+                "rst_mapbands: cell_of() trees need an explicit "
+                "resolution"
+            )
+        index_system = index
+        resolution = index.resolution_arg(resolution)
+    out: list[Raster] = []
+    for r in _rasters(col):
+        vals, _valid = map_pixels(
+            expr, r, tile=tile,
+            index_system=index_system, resolution=resolution,
+        )
+        out.append(
+            Raster(
+                data=vals[None, :, :],
+                gt=tuple(r.gt),
+                srid=r.srid,
+                nodata=float("nan"),
+            )
+        )
+    return out
+
+
+def rst_ndvi(col, nir_band: int = 2, red_band: int = 1,
+             tile=None) -> list[Raster]:
+    """NDVI ``(nir - red) / (nir + red)`` per raster as a fused
+    expression program (reference: RST_NDVI); pixels invalid in either
+    band come out NaN-nodata."""
+    from ..expr import ndvi
+
+    return rst_mapbands(col, ndvi(nir=nir_band, red=red_band), tile=tile)

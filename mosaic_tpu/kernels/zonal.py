@@ -37,7 +37,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pip import TilingError
 
-__all__ = ["zonal_fold", "zonal_tiled", "TilingError"]
+__all__ = ["zonal_fold", "zonal_fold_masked", "zonal_tiled", "TilingError"]
 
 #: inert fill for min/max lanes — far beyond any geographic or sensor
 #: value, well inside f32 range (same constant family as kernels/pip.py)
@@ -79,6 +79,23 @@ def zonal_fold(values, seg, num_segments: int, *, acc_dtype=None):
     )
     k = int(num_segments)
     return cnt[:k], s[:k], mn[:k], mx[:k]
+
+
+def zonal_fold_masked(values, valid, seg, num_segments: int, *,
+                      acc_dtype=None):
+    """:func:`zonal_fold` with an explicit per-pixel validity lane —
+    the pushdown hook of the expression compiler: a fused program
+    computes ``values`` and ``valid`` from raw bands (mask propagation
+    through the pad∧nodata∧NaN mask AND expression-level masking like
+    ``mask_where``) and folds them here inside the SAME jit, so the
+    whole pipeline is one launch. Invalid pixels fold nowhere
+    (segment forced to -1); NaN/Inf produced on them never reaches the
+    accumulators because :func:`zonal_fold` re-masks the value lanes on
+    segment validity."""
+    seg = jnp.asarray(seg, jnp.int32).reshape(-1)
+    valid = jnp.asarray(valid, bool).reshape(-1)
+    segm = jnp.where(valid, seg, np.int32(-1))
+    return zonal_fold(values, segm, num_segments, acc_dtype=acc_dtype)
 
 
 # --------------------------------------------------------- Pallas lane

@@ -246,12 +246,17 @@ class ZonalEngine:
 
             self._zones_fold = jax.jit(zones_fold)
 
-    def _tile_zone_stats(self, plan, t: int, vals_flat, mask_flat):
-        """One tile's zone partial ((g,) count, sum, min, max as numpy):
-        device probe with the epsilon band, exact f64 host re-join of the
-        banded pixels, device fold over the corrected segments. The host
-        patch is what makes the fold bit-identical to the f64 oracle even
-        for pixel centers landing exactly on zone edges."""
+    def _tile_zone_rows(self, plan, t: int, maskb=None) -> np.ndarray:
+        """(TH*TW,) zone row per pixel center of tile ``t`` (negative =
+        outside every zone): device probe with the epsilon band, exact
+        f64 host re-join of the banded pixels. The host patch is what
+        makes downstream folds bit-identical to the f64 oracle even for
+        pixel centers landing exactly on zone edges. ``maskb`` narrows
+        the patch to pixels that can contribute; ``None`` (the
+        expression path, where validity is decided INSIDE the fused
+        program) patches every banded pixel — membership is
+        band-independent, so the two are equivalent on every pixel that
+        reaches a fold."""
         th, tw = plan.shape
         if self.mesh is not None and (th * tw) % self.mesh.size:
             raise ValueError(
@@ -271,9 +276,10 @@ class ZonalEngine:
                 "heavy/found/convex caps — leave caps at None for exact "
                 "sizing"
             )
-        maskb = np.asarray(mask_flat, bool)
         if self._host is not None:
-            near = np.asarray(near_d) & maskb
+            near = np.asarray(near_d)
+            if maskb is not None:
+                near = near & maskb
             if near.any():
                 pts = host_tile_centers(plan, t)[near]
                 geom[near] = np.asarray(
@@ -282,6 +288,14 @@ class ZonalEngine:
                         self.resolution,
                     )
                 )
+        return geom
+
+    def _tile_zone_stats(self, plan, t: int, vals_flat, mask_flat):
+        """One tile's zone partial ((g,) count, sum, min, max as numpy):
+        probe + epsilon patch via :meth:`_tile_zone_rows`, then the
+        device fold over the corrected segments."""
+        maskb = np.asarray(mask_flat, bool)
+        geom = self._tile_zone_rows(plan, t, maskb)
         seg = np.where(maskb & (geom >= 0), geom, -1).astype(np.int32)
         cnt, s, mn, mx = self._zones_fold(
             jnp.asarray(vals_flat), jnp.asarray(seg)
@@ -415,6 +429,40 @@ class ZonalEngine:
             band=band,
             pixels=int(cnt_acc.sum()),
         )
+
+    # ------------------------------------------------------ expressions
+    def map(
+        self, expr, raster, *, tile: "tuple[int, int] | None" = None,
+        by: "str | None" = None, watchdog_default_s: float = 600.0,
+        retry_policy=None,
+    ):
+        """Evaluate a fused expression tree (`mosaic_tpu.expr`) over
+        ``raster``: one device program per tile bucket runs band math,
+        masking, and the terminal zonal fold in a single launch.
+        Zonal terminals return a :class:`ZonalResult`; ``.join()``
+        terminals return per-pixel (zone, value, valid) planes."""
+        from .. import expr as _expr  # local: expr imports this module
+
+        _value, kind, _by, _stats = _expr.terminal_of(expr)
+        if kind == "join":
+            return _expr.eval.map_join(self, expr, raster, tile=tile)
+        return _expr.map_zonal(
+            self, expr, raster, tile=tile, by=by,
+            watchdog_default_s=watchdog_default_s,
+            retry_policy=retry_policy,
+        )
+
+    def warmup_expr(
+        self, expr, raster, *, tile: "tuple[int, int] | None" = None,
+        by: "str | None" = None,
+    ) -> tuple:
+        """Precompile the probe and fused programs one :meth:`map` call
+        will dispatch (by executing them on zero tiles — AOT lowering
+        does not warm the jit dispatch cache); returns the registered
+        expression signature for `expr.freeze` bookkeeping."""
+        from .. import expr as _expr  # local: expr imports this module
+
+        return _expr.warmup_expr(self, expr, raster, tile=tile, by=by)
 
 
 def _result_from_dict(merged: dict, band: int) -> ZonalResult:

@@ -111,17 +111,21 @@ class RasterStream:
         raster,
         *,
         band: int = 1,
+        expr=None,
         tile: "tuple[int, int] | None" = None,
         run_dir: "str | None" = None,
         snapshot_every: int = 8,
         watchdog_default_s: float = 600.0,
         retry_policy=None,
     ) -> RasterScanResult:
-        """Scan one band into per-zone (count, sum, min, max). With
-        ``run_dir`` the scan is durable: interrupt anywhere and
-        :meth:`resume` finishes it."""
+        """Scan one band — or a fused expression tree over the band
+        stack (``expr=``, `mosaic_tpu.expr`) — into per-zone (count,
+        sum, min, max). With ``run_dir`` the scan is durable: interrupt
+        anywhere and :meth:`resume` finishes it. Durable expression
+        scans snapshot the tree's structural hash; resume refuses a
+        different tree."""
         return self._run(
-            raster, band=band, tile=tile, run_dir=run_dir,
+            raster, band=band, expr=expr, tile=tile, run_dir=run_dir,
             snapshot_every=int(snapshot_every), start_tile=0, acc0=None,
             resumed_from=None, watchdog_default_s=watchdog_default_s,
             retry_policy=retry_policy, trace_parent=None,
@@ -132,13 +136,15 @@ class RasterStream:
         run_dir: str,
         raster,
         *,
+        expr=None,
         watchdog_default_s: float = 600.0,
         retry_policy=None,
     ) -> RasterScanResult:
         """Restart an interrupted durable scan from the newest VALID
         snapshot under ``run_dir``. The snapshot's raster fingerprint,
-        tile shape, band, and zone count must match — resuming a fold
-        against different pixels would silently merge garbage."""
+        tile shape, band, zone count — and for expression scans the
+        expression hash — must match: resuming a fold against different
+        pixels OR a different tree would silently merge garbage."""
         loaded = _checkpoint.load_latest(run_dir)
         if loaded is None:
             raise FileNotFoundError(
@@ -158,9 +164,22 @@ class RasterStream:
                 f"snapshot zone count {meta.get('num_zones')} != this "
                 f"stream's {self.num_zones}"
             )
+        want_expr = meta.get("expr_sha256")
+        have_expr = None
+        if expr is not None:
+            from .. import expr as _expr  # lazy: see _zonal()
+
+            have_expr = _expr.tree_hash(expr)
+        if want_expr != have_expr:
+            raise ValueError(
+                "snapshot expression mismatch — the interrupted scan "
+                f"folded tree {want_expr!r}, resume was given "
+                f"{have_expr!r}; pass the same expression (structural "
+                "equality) or none at all"
+            )
         tile = tuple(meta["tile"]) if meta.get("tile") else None
         return self._run(
-            raster, band=int(meta.get("band", 1)), tile=tile,
+            raster, band=int(meta.get("band", 1)), expr=expr, tile=tile,
             run_dir=run_dir,
             snapshot_every=int(meta.get("snapshot_every", 8)),
             start_tile=int(step),
@@ -173,7 +192,7 @@ class RasterStream:
 
     # ------------------------------------------------------------ engine
     def _run(
-        self, raster, *, band, tile, run_dir, snapshot_every,
+        self, raster, *, band, expr, tile, run_dir, snapshot_every,
         start_tile, acc0, resumed_from, watchdog_default_s,
         retry_policy, trace_parent,
     ) -> RasterScanResult:
@@ -187,10 +206,12 @@ class RasterStream:
             parent=trace_parent,
             ntiles=plan.ntiles, th=th, tw=tw, band=band,
             zones=g, resumed_from=resumed_from,
+            fused=expr is not None,
         )
         try:
             return self._run_traced(
-                raster, plan=plan, band=band, run_dir=run_dir,
+                raster, plan=plan, band=band, expr=expr,
+                run_dir=run_dir,
                 snapshot_every=snapshot_every, start_tile=start_tile,
                 acc0=acc0, resumed_from=resumed_from,
                 watchdog_default_s=watchdog_default_s,
@@ -203,7 +224,7 @@ class RasterStream:
             root.end()
 
     def _run_traced(
-        self, raster, *, plan, band, run_dir, snapshot_every,
+        self, raster, *, plan, band, expr, run_dir, snapshot_every,
         start_tile, acc0, resumed_from, watchdog_default_s,
         retry_policy, root,
     ) -> RasterScanResult:
@@ -211,9 +232,39 @@ class RasterStream:
         th, tw = plan.shape
         g = self.num_zones
         eng = self.engine
-        vals, mask = tiles.stack_tiles(
-            raster, plan, band, dtype=np.float64
-        )
+        expr_sha = None
+        if expr is None:
+            vals, mask = tiles.stack_tiles(
+                raster, plan, band, dtype=np.float64
+            )
+        else:
+            # fused expression scan: stage the whole referenced band
+            # stack; per tile ONE program computes the tree and folds it
+            from .. import expr as _expr  # lazy: see _zonal()
+            from ..expr import compile as _ec, eval as _ee
+
+            value, kind, by, _stats = _expr.terminal_of(expr)
+            if kind != "zonal" or (by or "zones") != "zones":
+                raise ValueError(
+                    "RasterStream.scan(expr=...) folds zones — use a "
+                    "zones zonal terminal (or a bare value tree)"
+                )
+            _expr.validate(
+                expr, raster.num_bands, has_zones=True, by="zones"
+            )
+            expr_sha = _expr.tree_hash(expr)
+            expr_bands = _expr.bands_of(value)
+            vals, mask = _ee._stack_bands(raster, plan, expr_bands)
+            acc_name = str(np.dtype(eng.acc_dtype).name)
+            expr_prog = _ec.zonal_program(
+                value, th, tw, g, acc_name,
+                eng.index_system, eng.resolution,
+            )
+            expr_sig = _ec.signature_of(
+                value, th, tw, g, acc_name,
+                eng.index_system, eng.resolution, eng.mesh,
+            )
+            band = 0  # snapshot meta: fused scans read the stack
         if acc0 is None:
             cnt_acc = np.zeros(g, np.int64)
             sum_acc = np.zeros(g, np.float64)
@@ -235,6 +286,7 @@ class RasterStream:
                 "raster_sha256": _checkpoint.fingerprint(
                     np.ascontiguousarray(raster.data)
                 ),
+                "expr_sha256": expr_sha,
                 "trace": root.context.as_dict(),
             }
         host = getattr(self.chip_index, "host", None)
@@ -250,14 +302,28 @@ class RasterStream:
                 # retry/degrade, non-transient ones abort the run
                 for t in range(step, step + seg_n):
 
-                    def dispatch(t=t):
-                        # probe + epsilon-band host patch + fold; the
-                        # numpy returns force completion (what a real
-                        # stall would block on)
-                        return eng._tile_zone_stats(
-                            plan, t, vals[t].reshape(-1),
-                            mask[t].reshape(-1),
-                        )
+                    if expr is None:
+                        def dispatch(t=t):
+                            # probe + epsilon-band host patch + fold;
+                            # the numpy returns force completion (what
+                            # a real stall would block on)
+                            return eng._tile_zone_stats(
+                                plan, t, vals[t].reshape(-1),
+                                mask[t].reshape(-1),
+                            )
+                    else:
+                        def dispatch(t=t):
+                            # probe + epsilon patch, then the fused
+                            # expression+fold program — one launch
+                            geom = eng._tile_zone_rows(plan, t)
+                            seg = np.where(
+                                geom >= 0, geom, -1
+                            ).astype(np.int32)
+                            return _ec.run_zonal(
+                                expr_prog, expr_sig,
+                                np.asarray(plan.gt, np.float64),
+                                plan.origins[t], vals[t], mask[t], seg,
+                            )
 
                     try:
                         cnt, s, mn, mx = _dispatch.guarded_call(
@@ -273,11 +339,25 @@ class RasterStream:
                             attempts=e.attempts,
                             error=repr(e.last)[:200],
                         )
-                        cnt, s, mn, mx = zonal.host_zone_partial(
-                            zonal.host_tile_centers(plan, t),
-                            vals[t].reshape(-1), mask[t].reshape(-1),
-                            host, self.index_system, self.resolution, g,
-                        )
+                        if expr is None:
+                            cnt, s, mn, mx = zonal.host_zone_partial(
+                                zonal.host_tile_centers(plan, t),
+                                vals[t].reshape(-1),
+                                mask[t].reshape(-1),
+                                host, self.index_system,
+                                self.resolution, g,
+                            )
+                        else:
+                            cnt, s, mn, mx = (
+                                _expr.host_expr_tile_partial(
+                                    value, vals[t], mask[t],
+                                    zonal.host_tile_centers(plan, t),
+                                    index_system=self.index_system,
+                                    resolution=self.resolution,
+                                    host=host, num_segments=g,
+                                    by="zones",
+                                )
+                            )
                         degraded_tiles += 1
                     cnt = np.asarray(cnt, np.int64)
                     live = cnt > 0

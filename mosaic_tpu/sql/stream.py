@@ -67,6 +67,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import os
 import time
 import warnings
 
@@ -484,6 +485,10 @@ class StreamJoin:
         self._loop = progs.loop
         self._donate_loop = progs.donate_loop
         self._seg_loop = progs.seg_loop
+        #: (ring shape+dtype, nb, collect) signatures this instance has
+        #: warmed — the jit cache itself lives on the shared program
+        #: bundle, this only stops repeat warm executions per stream
+        self._seg_warm: set = set()
 
     def _check_batch(self, batch: int) -> None:
         if self.mesh is not None and int(batch) % self.mesh.size:
@@ -690,6 +695,56 @@ class StreamJoin:
             bounds,
         )
 
+    def _warm_seg_loop(
+        self, ring, cells, start_step: int, n_batches: int,
+        snapshot_every: int, collect: bool,
+    ) -> None:
+        """Compile the durable-segment executables BEFORE the segment
+        loop starts.
+
+        Round-12 stall attribution (``STALL_r12.json``) put 1.95 s of a
+        2.28 s durable run inside ``stream.segment[0]`` — almost all of
+        it the seg_loop trace+compile, booked as *device* time because
+        it happened under the segment span. Executing each distinct
+        ``nb`` signature here (at most two: ``snapshot_every`` and the
+        tail remainder; execution is required — AOT lowering does not
+        populate the jit dispatch cache) moves that wall time under a
+        ``dispatch.compile`` span, where timeline attribution classifies
+        it as compile. Costs up to two warm segments of compute; set
+        ``MOSAIC_STREAM_NO_SEG_WARMUP=1`` to skip and eat the
+        segment[0] compile instead."""
+        if os.environ.get("MOSAIC_STREAM_NO_SEG_WARMUP"):
+            return
+        sizes = sorted({
+            min(snapshot_every, n_batches - s)
+            for s in range(start_step, n_batches, snapshot_every)
+        })
+        key0 = (tuple(ring.shape), str(ring.dtype), bool(collect))
+        sizes = [
+            nb for nb in sizes if (key0, nb) not in self._seg_warm
+        ]
+        if not sizes:
+            return
+        c0 = _dispatch.backend_compiles()
+        span = _trace.start_span(
+            "dispatch.compile", site="stream.seg_loop",
+            sizes=repr(sizes),
+        )
+        try:
+            acc0 = jnp.zeros(3, jnp.int32)
+            for nb in sizes:
+                a, _c, _o = self._seg_loop(
+                    ring, self.index, jnp.int32(int(start_step)),
+                    acc0, cells, nb=nb, collect=collect,
+                )
+                jax.block_until_ready(a)
+                self._seg_warm.add((key0, nb))
+        finally:
+            span.set(
+                backend_compiles=_dispatch.backend_compiles() - c0
+            )
+            span.end()
+
     def _host_segment(self, ring_np, i0: int, nb: int, collect: bool):
         """f64 host-oracle evaluation of batches [i0, i0+nb) — the
         degradation fallback when a segment's device path fails past the
@@ -882,6 +937,12 @@ class StreamJoin:
         snapshots = 0
         outs_list: list[np.ndarray] = []
         host = getattr(self.index, "host", None)
+        # compile the segment executables up front, under a compile
+        # span — NOT inside segment[0]'s device-attributed wall time
+        self._warm_seg_loop(
+            ring, cells, start_step, int(n_batches),
+            int(snapshot_every), collect,
+        )
         step = start_step
         t0 = time.perf_counter()
         while step < n_batches:

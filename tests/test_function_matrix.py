@@ -24,6 +24,7 @@ import numpy as np
 import pytest
 
 import mosaic_tpu
+from mosaic_tpu import expr as E
 from mosaic_tpu import functions as F
 from mosaic_tpu.core.index import CustomIndexSystem, GridConf, H3, BNG
 from mosaic_tpu.core.geometry import wkt as W
@@ -332,6 +333,10 @@ def _raster_specs(e):
         "rst_upperlefty": lambda: F.rst_upperlefty(col),
         "rst_pixelwidth": lambda: F.rst_pixelwidth(col),
         "rst_pixelheight": lambda: F.rst_pixelheight(col),
+        "rst_mapbands": lambda: F.rst_mapbands(
+            col, E.band(1).mask_where(E.band(2) > 0.0)
+        ),
+        "rst_ndvi": lambda: F.rst_ndvi(col),
         "rst_rotation": lambda: F.rst_rotation(col),
         "rst_rastertoworldcoord": lambda: F.rst_rastertoworldcoord(col, 2, 3),
         "rst_rastertoworldcoordx": lambda: F.rst_rastertoworldcoordx(col, 2, 3),
