@@ -38,12 +38,20 @@ from .core import (
     sharded_pointwise,
     stream_programs,
 )
+from .pipeline import (
+    PipelineStats,
+    SnapshotWriter,
+    execute_pipeline,
+    resolve_window,
+)
 
 __all__ = [
     "BucketLadder",
     "DEFAULT_MAX_BUCKET",
     "DEFAULT_MIN_BUCKET",
     "DispatchCore",
+    "PipelineStats",
+    "SnapshotWriter",
     "backend_compiles",
     "bounded_cache",
     "cache_stats",
@@ -53,6 +61,7 @@ __all__ = [
     "core_for",
     "data_mesh",
     "dispatch_signature",
+    "execute_pipeline",
     "guarded_call",
     "jit_compact",
     "jit_counts",
@@ -62,6 +71,7 @@ __all__ = [
     "probe_check_rep",
     "register_cache",
     "resolve_mesh",
+    "resolve_window",
     "sharded_join_prog",
     "sharded_pointwise",
     "stream_programs",

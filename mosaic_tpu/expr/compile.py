@@ -287,22 +287,33 @@ def _untrack(span, c0):
     span.end()
 
 
+def run_zonal_async(prog, sig: tuple, gt, origin, vals, mask, seg):
+    """Execute a fused program under signature tracking, returning the
+    four partials as DEVICE arrays (async dispatch — the caller owns
+    the blocking pull). Tracing/compilation is synchronous inside the
+    jit call, so compile counts still land inside the span; only the
+    device execution escapes it."""
+    span, c0 = _track(sig)
+    try:
+        return prog(
+            jnp.asarray(gt), jnp.asarray(origin),
+            jnp.asarray(vals), jnp.asarray(mask), jnp.asarray(seg),
+        )
+    finally:
+        _untrack(span, c0)
+
+
 def run_zonal(prog, sig: tuple, gt, origin, vals, mask, seg):
     """Execute a fused program under signature tracking; returns the
     four partials as numpy arrays (blocking pulls, so a compile is
     fully inside the span)."""
-    span, c0 = _track(sig)
-    try:
-        cnt, s, mn, mx = prog(
-            jnp.asarray(gt), jnp.asarray(origin),
-            jnp.asarray(vals), jnp.asarray(mask), jnp.asarray(seg),
-        )
-        return (
-            np.asarray(cnt), np.asarray(s), np.asarray(mn),
-            np.asarray(mx),
-        )
-    finally:
-        _untrack(span, c0)
+    cnt, s, mn, mx = run_zonal_async(
+        prog, sig, gt, origin, vals, mask, seg
+    )
+    return (
+        np.asarray(cnt), np.asarray(s), np.asarray(mn),
+        np.asarray(mx),
+    )
 
 
 def run_pixels(prog, sig: tuple, gt, origin, vals, mask, seg):

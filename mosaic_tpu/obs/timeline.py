@@ -73,14 +73,24 @@ CLASS_RULES: tuple = (
     # -- queue_wait: admitted but not yet in a forming batch
     ("queue_wait", "serve_stage.queue_wait"),
     # -- host_callback: host-side work the device waits out
-    #    (snapshot writes, admission scrubbing, quarantine probes)
+    #    (snapshot writes, admission scrubbing, quarantine probes);
+    #    pipelined runs emit stream.snapshot from the writer thread —
+    #    same class, but now its interval OVERLAPS device intervals
+    #    instead of serializing after them (the flatten priority still
+    #    books the overlap to the device's thief classes correctly)
     ("host_callback", "span.stream.snapshot"),
     ("host_callback", "span.raster.snapshot"),
     ("host_callback", "span.stream.admit"),
     ("host_callback", "span.serve.admit"),
+    ("host_callback", "span.stream.pipeline.flush"),
+    ("host_callback", "stream_stage.pipeline_flush"),
     ("host_callback", "quarantine_stage.*"),
     ("host_callback", "recheck_narrow"),
     # -- device: the useful work everything above steals from
+    #    (the pipeline drain is the bounded window's one blocking pull:
+    #    the wall it spends is device execution the host waits out)
+    ("device", "span.stream.pipeline.drain"),
+    ("device", "stream_stage.pipeline_drain"),
     ("device", "span.stream.segment"),
     ("device", "span.serve.dispatch"),
     ("device", "span.serve.batch"),
@@ -347,3 +357,19 @@ def overlap_s(a, b) -> float:
         else:
             j += 1
     return round(total, 6)
+
+
+def overlap_fraction(a, b) -> float:
+    """The share of ``a``'s busy seconds hidden under ``b`` —
+    ``overlap_s(a, b) / busy(a)``, 0.0 when ``a`` is empty.
+
+    This is the pipeline's "off the critical path" claim as a number:
+    with ``a`` = snapshot ``host_callback`` intervals and ``b`` =
+    ``device`` intervals, a synchronous loop scores ~0 (snapshots
+    serialize after compute) and a pipelined run approaches 1 (the
+    writer thread runs while the next segments execute)."""
+    am = merge_intervals(a)
+    busy = sum(e - s for s, e in am)
+    if busy <= 0:
+        return 0.0
+    return round(overlap_s(am, b) / busy, 6)

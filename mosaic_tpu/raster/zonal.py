@@ -290,15 +290,26 @@ class ZonalEngine:
                 )
         return geom
 
-    def _tile_zone_stats(self, plan, t: int, vals_flat, mask_flat):
-        """One tile's zone partial ((g,) count, sum, min, max as numpy):
-        probe + epsilon patch via :meth:`_tile_zone_rows`, then the
-        device fold over the corrected segments."""
+    def _tile_zone_stats_async(self, plan, t: int, vals_flat, mask_flat):
+        """One tile's zone partial as DEVICE arrays — async dispatch,
+        no blocking pull. The probe + epsilon host patch
+        (:meth:`_tile_zone_rows`) still complete on the host (the patch
+        is a host re-join by construction), but the (g,)-fold's results
+        are returned as futures so a pipelined caller can overlap this
+        tile's fold with the next tile's probe and pull at its drain
+        point."""
         maskb = np.asarray(mask_flat, bool)
         geom = self._tile_zone_rows(plan, t, maskb)
         seg = np.where(maskb & (geom >= 0), geom, -1).astype(np.int32)
-        cnt, s, mn, mx = self._zones_fold(
-            jnp.asarray(vals_flat), jnp.asarray(seg)
+        return self._zones_fold(jnp.asarray(vals_flat), jnp.asarray(seg))
+
+    def _tile_zone_stats(self, plan, t: int, vals_flat, mask_flat):
+        """One tile's zone partial ((g,) count, sum, min, max as numpy):
+        probe + epsilon patch via :meth:`_tile_zone_rows`, then the
+        device fold over the corrected segments. The numpy returns are
+        the blocking pulls (what a real stall would block on)."""
+        cnt, s, mn, mx = self._tile_zone_stats_async(
+            plan, t, vals_flat, mask_flat
         )
         return (
             np.asarray(cnt), np.asarray(s), np.asarray(mn),

@@ -30,7 +30,7 @@ import time
 
 import numpy as np
 
-from ..dispatch import core as _dispatch
+from ..dispatch import core as _dispatch, pipeline as _pipeline
 from ..obs import trace as _trace
 from ..runtime import (
     checkpoint as _checkpoint,
@@ -117,18 +117,27 @@ class RasterStream:
         snapshot_every: int = 8,
         watchdog_default_s: float = 600.0,
         retry_policy=None,
+        window: "int | None" = None,
     ) -> RasterScanResult:
         """Scan one band — or a fused expression tree over the band
         stack (``expr=``, `mosaic_tpu.expr`) — into per-zone (count,
         sum, min, max). With ``run_dir`` the scan is durable: interrupt
         anywhere and :meth:`resume` finishes it. Durable expression
         scans snapshot the tree's structural hash; resume refuses a
-        different tree."""
+        different tree.
+
+        Tiles ride the pipelined execution core
+        (`dispatch/pipeline.py`): up to ``window`` tile folds are in
+        flight at once (default: the ``MOSAIC_STREAM_WINDOW`` knob),
+        so tile i's device fold overlaps tile i+1's host probe/patch —
+        double-buffering for free. Accumulation and snapshots happen
+        at the ordered drain, so the fold order (and therefore the
+        result, bit for bit) is the synchronous loop's."""
         return self._run(
             raster, band=band, expr=expr, tile=tile, run_dir=run_dir,
             snapshot_every=int(snapshot_every), start_tile=0, acc0=None,
             resumed_from=None, watchdog_default_s=watchdog_default_s,
-            retry_policy=retry_policy, trace_parent=None,
+            retry_policy=retry_policy, trace_parent=None, window=window,
         )
 
     def resume(
@@ -139,6 +148,7 @@ class RasterStream:
         expr=None,
         watchdog_default_s: float = 600.0,
         retry_policy=None,
+        window: "int | None" = None,
     ) -> RasterScanResult:
         """Restart an interrupted durable scan from the newest VALID
         snapshot under ``run_dir``. The snapshot's raster fingerprint,
@@ -188,13 +198,14 @@ class RasterStream:
             watchdog_default_s=watchdog_default_s,
             retry_policy=retry_policy,
             trace_parent=_trace.SpanContext.from_dict(meta.get("trace")),
+            window=window,
         )
 
     # ------------------------------------------------------------ engine
     def _run(
         self, raster, *, band, expr, tile, run_dir, snapshot_every,
         start_tile, acc0, resumed_from, watchdog_default_s,
-        retry_policy, trace_parent,
+        retry_policy, trace_parent, window=None,
     ) -> RasterScanResult:
         tiles, _zn = _zonal()
         plan = tiles.plan_tiles(raster, tile)
@@ -215,7 +226,7 @@ class RasterStream:
                 snapshot_every=snapshot_every, start_tile=start_tile,
                 acc0=acc0, resumed_from=resumed_from,
                 watchdog_default_s=watchdog_default_s,
-                retry_policy=retry_policy, root=root,
+                retry_policy=retry_policy, root=root, window=window,
             )
         except BaseException as e:  # noqa: BLE001 — stamped, re-raised
             root.set(error=type(e).__name__)
@@ -226,7 +237,7 @@ class RasterStream:
     def _run_traced(
         self, raster, *, plan, band, expr, run_dir, snapshot_every,
         start_tile, acc0, resumed_from, watchdog_default_s,
-        retry_policy, root,
+        retry_policy, root, window=None,
     ) -> RasterScanResult:
         tiles, zonal = _zonal()
         th, tw = plan.shape
@@ -290,100 +301,123 @@ class RasterStream:
                 "trace": root.context.as_dict(),
             }
         host = getattr(self.chip_index, "host", None)
-        degraded_tiles = 0
-        snapshots = 0
-        step = int(start_tile)
-        t0 = time.perf_counter()
-        while step < plan.ntiles:
-            seg_n = min(snapshot_every, plan.ntiles - step)
-            with _trace.span("raster.zonal", step=step, n=seg_n):
-                # fault plans trip inside the guard (the watchdog runs
-                # maybe_fail under the retry wrapper): transient errors
-                # retry/degrade, non-transient ones abort the run
-                for t in range(step, step + seg_n):
+        degraded = [0]
+        counters = {"snapshots": 0}
+        start = int(start_tile)
+        win = _pipeline.resolve_window(window)
 
+        # tiles ride the pipelined execution core: launch dispatches
+        # tile t's fold WITHOUT the blocking pull (the probe's host
+        # patch still completes here — it is host work by construction),
+        # the ordered drain materializes + accumulates, so the fold
+        # order — and therefore the float result, bit for bit — is the
+        # synchronous loop's. Fault plans trip inside the launch guard
+        # (the watchdog runs maybe_fail under the retry wrapper):
+        # transient errors retry/degrade, non-transient ones abort.
+        def launch(i):
+            t = start + i
+
+            if expr is None:
+                def dispatch(t=t):
+                    return eng._tile_zone_stats_async(
+                        plan, t, vals[t].reshape(-1),
+                        mask[t].reshape(-1),
+                    )
+            else:
+                def dispatch(t=t):
+                    # probe + epsilon patch, then the fused
+                    # expression+fold program — one launch
+                    geom = eng._tile_zone_rows(plan, t)
+                    seg = np.where(
+                        geom >= 0, geom, -1
+                    ).astype(np.int32)
+                    return _ec.run_zonal_async(
+                        expr_prog, expr_sig,
+                        np.asarray(plan.gt, np.float64),
+                        plan.origins[t], vals[t], mask[t], seg,
+                    )
+
+            with _trace.span(
+                "raster.zonal", step=t, n=1, pipelined=True
+            ):
+                try:
+                    return _dispatch.guarded_call(
+                        "raster.zonal", dispatch,
+                        default_s=watchdog_default_s,
+                        policy=retry_policy,
+                    )
+                except RetryExhausted as e:
+                    if host is None:
+                        raise
+                    _telemetry.record(
+                        "degraded", label="raster.zonal", step=t,
+                        attempts=e.attempts,
+                        error=repr(e.last)[:200],
+                    )
+                    degraded[0] += 1
                     if expr is None:
-                        def dispatch(t=t):
-                            # probe + epsilon-band host patch + fold;
-                            # the numpy returns force completion (what
-                            # a real stall would block on)
-                            return eng._tile_zone_stats(
-                                plan, t, vals[t].reshape(-1),
-                                mask[t].reshape(-1),
-                            )
-                    else:
-                        def dispatch(t=t):
-                            # probe + epsilon patch, then the fused
-                            # expression+fold program — one launch
-                            geom = eng._tile_zone_rows(plan, t)
-                            seg = np.where(
-                                geom >= 0, geom, -1
-                            ).astype(np.int32)
-                            return _ec.run_zonal(
-                                expr_prog, expr_sig,
-                                np.asarray(plan.gt, np.float64),
-                                plan.origins[t], vals[t], mask[t], seg,
-                            )
+                        return zonal.host_zone_partial(
+                            zonal.host_tile_centers(plan, t),
+                            vals[t].reshape(-1),
+                            mask[t].reshape(-1),
+                            host, self.index_system,
+                            self.resolution, g,
+                        )
+                    return _expr.host_expr_tile_partial(
+                        value, vals[t], mask[t],
+                        zonal.host_tile_centers(plan, t),
+                        index_system=self.index_system,
+                        resolution=self.resolution,
+                        host=host, num_segments=g,
+                        by="zones",
+                    )
 
-                    try:
-                        cnt, s, mn, mx = _dispatch.guarded_call(
-                            "raster.zonal", dispatch,
-                            default_s=watchdog_default_s,
-                            policy=retry_policy,
-                        )
-                    except RetryExhausted as e:
-                        if host is None:
-                            raise
-                        _telemetry.record(
-                            "degraded", label="raster.zonal", step=t,
-                            attempts=e.attempts,
-                            error=repr(e.last)[:200],
-                        )
-                        if expr is None:
-                            cnt, s, mn, mx = zonal.host_zone_partial(
-                                zonal.host_tile_centers(plan, t),
-                                vals[t].reshape(-1),
-                                mask[t].reshape(-1),
-                                host, self.index_system,
-                                self.resolution, g,
-                            )
-                        else:
-                            cnt, s, mn, mx = (
-                                _expr.host_expr_tile_partial(
-                                    value, vals[t], mask[t],
-                                    zonal.host_tile_centers(plan, t),
-                                    index_system=self.index_system,
-                                    resolution=self.resolution,
-                                    host=host, num_segments=g,
-                                    by="zones",
-                                )
-                            )
-                        degraded_tiles += 1
-                    cnt = np.asarray(cnt, np.int64)
-                    live = cnt > 0
-                    cnt_acc += cnt
-                    sum_acc = sum_acc + np.asarray(s, np.float64)
-                    mn = np.asarray(mn, np.float64)
-                    mx = np.asarray(mx, np.float64)
-                    min_acc[live] = np.minimum(min_acc[live], mn[live])
-                    max_acc[live] = np.maximum(max_acc[live], mx[live])
-            step += seg_n
-            if run_dir is not None:
+        def land(i, handle):
+            nonlocal cnt_acc, sum_acc
+            cnt, s, mn, mx = handle
+            cnt = np.asarray(cnt, np.int64)  # blocks: the drain's pull
+            live = cnt > 0
+            cnt_acc += cnt
+            sum_acc = sum_acc + np.asarray(s, np.float64)
+            mn = np.asarray(mn, np.float64)
+            mx = np.asarray(mx, np.float64)
+            min_acc[live] = np.minimum(min_acc[live], mn[live])
+            max_acc[live] = np.maximum(max_acc[live], mx[live])
+            se = start + i + 1
+            if run_dir is not None and (
+                (se - start) % snapshot_every == 0 or se == plan.ntiles
+            ):
                 payload = {
                     "count": cnt_acc, "sum": sum_acc,
                     "min": min_acc, "max": max_acc,
                 }
-                with _trace.span("raster.snapshot", step=step):
+                with _trace.span("raster.snapshot", step=se):
                     try:
                         _checkpoint.save_snapshot(
-                            run_dir, step, payload, meta
+                            run_dir, se, payload, meta
                         )
-                        snapshots += 1
+                        counters["snapshots"] += 1
                     except Exception as e:  # lint: broad-except-ok (durability degrades — coarser resume point — but a sick disk must not kill the scan)
                         _telemetry.record(
                             "snapshot_skipped", run_dir=run_dir,
-                            step=step, error=repr(e)[:200],
+                            step=se, error=repr(e)[:200],
                         )
+
+        def replay(lo, hi):
+            # tiles carry no cross-tile device state, so the
+            # synchronous path IS launch + immediate land — the full
+            # guarded retry/degradation budget applies per tile
+            for j in range(lo, hi + 1):
+                land(j, launch(j))
+
+        t0 = time.perf_counter()
+        pstats = _pipeline.execute_pipeline(
+            plan.ntiles - start, launch, land,
+            drain_site="raster.pipeline.drain", replay=replay,
+            window=win, watchdog_default_s=watchdog_default_s,
+        )
+        degraded_tiles = degraded[0]
+        snapshots = counters["snapshots"]
         wall = time.perf_counter() - t0
         n_run = plan.ntiles - int(start_tile)
         px_run = n_run * th * tw
@@ -392,6 +426,7 @@ class RasterStream:
             seconds=round(wall, 6), ntiles=plan.ntiles,
             th=th, tw=tw, zones=g, snapshots=snapshots,
             degraded_tiles=degraded_tiles, resumed_from=resumed_from,
+            window=pstats.window,
             pixels_per_sec=round(px_run / max(wall, 1e-9), 1),
         )
         live = cnt_acc > 0
@@ -416,6 +451,7 @@ class RasterStream:
                 "snapshots": snapshots,
                 "resumed_from": resumed_from,
                 "run_dir": run_dir,
+                "pipeline": pstats.as_dict(),
             },
         )
 

@@ -75,7 +75,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..dispatch import core as _dispatch
+from ..dispatch import core as _dispatch, pipeline as _pipeline
 from ..obs import metrics as _metrics, trace as _trace
 from ..runtime import (
     checkpoint as _checkpoint,
@@ -775,12 +775,26 @@ class StreamJoin:
         extra_arrays: dict | None = None,
         watchdog_default_s: float = 600.0,
         retry_policy: "RetryPolicy | None" = None,
+        pipeline: "bool | None" = None,
+        window: "int | None" = None,
     ) -> StreamResult:
         """A streamed pass that survives device loss: the scan runs in
         segments of ``snapshot_every`` ring cycles, persisting the scan
         carry (fold accumulators, ring cursor, prefetched cell ids, any
         ``extra_arrays`` such as the generator key) to ``run_dir`` after
         each segment (`runtime/checkpoint.py`: checksummed, atomic).
+
+        ``pipeline=True`` (default: the ``MOSAIC_STREAM_PIPELINE``
+        knob) runs the segments through the asynchronous pipelined
+        executor (`dispatch/pipeline.py`): the fold accumulator and
+        prefetched cells stay device-resident across segments, up to
+        ``window`` segments (``MOSAIC_STREAM_WINDOW``, default 4) are
+        in flight at once, and snapshot I/O runs on a background writer
+        thread off the device's critical path. Bit-identical to the
+        synchronous loop — the carry chain is the same int32 fold — and
+        the durability contract is unchanged: a snapshot is durable
+        only once its background write completes, and resume replays
+        from the last *completed* snapshot.
 
         Identical final (checksum, matches, overflow) to :meth:`run` —
         int32 fold addition segments exactly, cell prefetch is
@@ -804,6 +818,7 @@ class StreamJoin:
             resumed_from=None, extra_arrays=extra_arrays,
             watchdog_default_s=watchdog_default_s,
             retry_policy=retry_policy,
+            pipeline=pipeline, window=window,
         )
 
     def resume(
@@ -814,6 +829,8 @@ class StreamJoin:
         collect: bool = False,
         watchdog_default_s: float = 600.0,
         retry_policy: "RetryPolicy | None" = None,
+        pipeline: "bool | None" = None,
+        window: "int | None" = None,
     ) -> StreamResult:
         """Restart an interrupted :meth:`run_durable` from the last
         VALID snapshot in ``run_dir`` (corrupt/truncated snapshots are
@@ -869,16 +886,23 @@ class StreamJoin:
             watchdog_default_s=watchdog_default_s,
             retry_policy=retry_policy,
             trace_parent=_trace.SpanContext.from_dict(meta.get("trace")),
+            pipeline=pipeline, window=window,
         )
 
     def _run_segments(
         self, ring, n_batches, *, run_dir, snapshot_every, start_step,
         acc0, cells0, collect, resumed_from, extra_arrays,
         watchdog_default_s, retry_policy, trace_parent=None,
+        pipeline=None, window=None,
     ) -> StreamResult:
         k, batch = int(ring.shape[0]), int(ring.shape[1])
         self._check_batch(batch)
         snapshot_every = max(1, snapshot_every)
+        if pipeline is None:
+            # mode knob resolved at call time, never inside traced code
+            pipeline = os.environ.get(
+                "MOSAIC_STREAM_PIPELINE", ""
+            ) not in ("", "0")
         ring_np = np.asarray(ring)  # host twin: fingerprint + fallback
         ring_fp = _checkpoint.fingerprint(ring_np)
         # one root span per durable run; a resume parents to the
@@ -890,9 +914,15 @@ class StreamJoin:
             n_batches=int(n_batches),
             resumed_from=resumed_from,
             snapshot_every=int(snapshot_every),
+            pipelined=bool(pipeline),
         )
+        runner = (
+            self._run_segments_pipelined if pipeline
+            else self._run_segments_traced
+        )
+        kw = {"window": window} if pipeline else {}
         try:
-            return self._run_segments_traced(
+            return runner(
                 ring, n_batches, run_dir=run_dir,
                 snapshot_every=snapshot_every, start_step=start_step,
                 acc0=acc0, cells0=cells0, collect=collect,
@@ -900,6 +930,7 @@ class StreamJoin:
                 watchdog_default_s=watchdog_default_s,
                 retry_policy=retry_policy, root=root,
                 ring_np=ring_np, ring_fp=ring_fp, k=k, batch=batch,
+                **kw,
             )
         except BaseException as e:  # noqa: BLE001 — stamped, re-raised
             root.set(error=type(e).__name__)
@@ -947,61 +978,20 @@ class StreamJoin:
         t0 = time.perf_counter()
         while step < n_batches:
             seg_n = min(snapshot_every, n_batches - step)
-            acc_i32 = jnp.asarray(_wrap_i32(acc).astype(np.int32))
-            cells_arg = cells
-
-            def dispatch():
-                a, c, o = self._seg_loop(
-                    ring, self.index, jnp.int32(step), acc_i32,
-                    cells_arg, nb=seg_n, collect=collect,
-                )
-                # one host pull forces completion (and is what a real
-                # stall would block on)
-                return (
-                    np.asarray(a), c,
-                    np.asarray(o) if collect else None,
-                )
-
-            with _trace.span("stream.segment", step=step, n=seg_n):
-                try:
-                    a_np, cells_new, o_np = _dispatch.guarded_call(
-                        "stream.scan_step", dispatch,
-                        default_s=watchdog_default_s,
-                        policy=retry_policy,
-                    )
-                    acc = np.asarray(a_np, np.int64)
-                    cells = cells_new
-                except RetryExhausted as e:
-                    if host is None:
-                        raise
-                    _telemetry.record(
-                        "degraded", label="stream.scan_step", step=step,
-                        attempts=e.attempts, error=repr(e.last)[:200],
-                    )
-                    delta, o_np = self._host_segment(
-                        ring_np, step, seg_n, collect
-                    )
-                    acc = _wrap_i32(acc + delta)
-                    degraded_segments += 1
-                    if self.prefetch:
-                        cells = self.assign(ring[(step + seg_n) % k])
+            acc, cells, o_np, degr = self._segment_sync(
+                ring, ring_np, step, seg_n, acc, cells,
+                collect=collect, watchdog_default_s=watchdog_default_s,
+                retry_policy=retry_policy, host=host,
+            )
+            degraded_segments += int(degr)
             if collect and o_np is not None:
                 outs_list.append(o_np)
             step += seg_n
 
             def snap():
-                payload = {"acc": _wrap_i32(acc).astype(np.int32)}
-                if self.prefetch:
-                    # a TRUE D2H interval: the segment's compute is
-                    # already forced complete by the acc pull above, so
-                    # this measures the copy, not hidden device work
-                    with _trace.span(
-                        "dispatch.transfer.d2h", site="stream.snapshot",
-                        nbytes=int(getattr(cells, "nbytes", 0)),
-                    ):
-                        payload["cells"] = np.asarray(cells)
-                for key, val in (extra_arrays or {}).items():
-                    payload[f"x_{key}"] = np.asarray(val)
+                payload = self._snapshot_payload(
+                    acc, cells, extra_arrays
+                )
                 return _checkpoint.save_snapshot(
                     run_dir, step, payload, meta
                 )
@@ -1053,6 +1043,324 @@ class StreamJoin:
             overflow=int(acc_w[2]),
             n_points=n_points,
             n_batches=n_batches,
+            batch=batch,
+            wall_s=wall,
+            points_per_sec=n_run * batch / max(wall, 1e-9),
+            prefetch=self.prefetch,
+            outs=(
+                np.concatenate(outs_list)
+                if collect and outs_list
+                else None
+            ),
+            metrics=metrics,
+        )
+
+    def _segment_sync(
+        self, ring, ring_np, step, seg_n, acc, cells, *, collect,
+        watchdog_default_s, retry_policy, host,
+    ):
+        """One synchronous durable segment: dispatch + blocking pull
+        under the ``stream.scan_step`` guard, host-oracle degradation
+        past the retry budget. Returns ``(acc int64, cells, outs |
+        None, degraded)``. Shared by the synchronous loop and the
+        pipelined executor's transient-replay path — replay IS the
+        synchronous path, so its semantics cannot drift."""
+        k = int(ring.shape[0])
+        acc_i32 = jnp.asarray(_wrap_i32(acc).astype(np.int32))
+        cells_arg = cells
+
+        def dispatch():
+            a, c, o = self._seg_loop(
+                ring, self.index, jnp.int32(step), acc_i32,
+                cells_arg, nb=seg_n, collect=collect,
+            )
+            # one host pull forces completion (and is what a real
+            # stall would block on)
+            return (
+                np.asarray(a), c,
+                np.asarray(o) if collect else None,
+            )
+
+        with _trace.span("stream.segment", step=step, n=seg_n):
+            try:
+                a_np, cells_new, o_np = _dispatch.guarded_call(
+                    "stream.scan_step", dispatch,
+                    default_s=watchdog_default_s,
+                    policy=retry_policy,
+                )
+                return np.asarray(a_np, np.int64), cells_new, o_np, False
+            except RetryExhausted as e:
+                if host is None:
+                    raise
+                _telemetry.record(
+                    "degraded", label="stream.scan_step", step=step,
+                    attempts=e.attempts, error=repr(e.last)[:200],
+                )
+                delta, o_np = self._host_segment(
+                    ring_np, step, seg_n, collect
+                )
+                acc = _wrap_i32(np.asarray(acc, np.int64) + delta)
+                if self.prefetch:
+                    cells = self.assign(ring[(step + seg_n) % k])
+                return acc, cells, o_np, True
+
+    def _snapshot_payload(self, acc, cells, extra_arrays) -> dict:
+        """The snapshot carry arrays, every device pull under a
+        ``dispatch.transfer.d2h`` span (``cells`` AND the ``x_<key>``
+        passthroughs — timeline transfer accounting is complete)."""
+        payload = {"acc": _wrap_i32(acc).astype(np.int32)}
+        if self.prefetch and cells is not None:
+            # a TRUE D2H interval: the segment's compute is already
+            # forced complete by the acc pull, so this measures the
+            # copy, not hidden device work
+            with _trace.span(
+                "dispatch.transfer.d2h", site="stream.snapshot",
+                nbytes=int(getattr(cells, "nbytes", 0)),
+            ):
+                payload["cells"] = np.asarray(cells)
+        for key, val in (extra_arrays or {}).items():
+            with _trace.span(
+                "dispatch.transfer.d2h", site="stream.snapshot",
+                nbytes=int(getattr(val, "nbytes", 0)), key=key,
+            ):
+                payload[f"x_{key}"] = np.asarray(val)
+        return payload
+
+    def _run_segments_pipelined(
+        self, ring, n_batches, *, run_dir, snapshot_every, start_step,
+        acc0, cells0, collect, resumed_from, extra_arrays,
+        watchdog_default_s, retry_policy, root, ring_np, ring_fp,
+        k, batch, window=None,
+    ) -> StreamResult:
+        """The asynchronous pipelined durable loop.
+
+        Segment i+1 is dispatched while segment i still executes: the
+        int32 fold accumulator and prefetched cells chain device to
+        device (no per-segment host round-trip — bit-identical, the
+        device fold IS the int32 wraparound `_wrap_i32` emulates), the
+        blocking pull happens at the bounded window's drain, and the
+        snapshot write runs on a `dispatch.pipeline.SnapshotWriter`
+        thread so checkpoint I/O overlaps the next segments' compute.
+        Transient failures at the drain replay through
+        :meth:`_segment_sync` from the last materialized carry;
+        degradation/watchdog/fault-injection semantics are the
+        synchronous loop's (same ``stream.scan_step`` /
+        ``stream.snapshot`` sites)."""
+        acc_host = (
+            np.zeros(3, np.int64) if acc0 is None
+            else _wrap_i32(np.asarray(acc0, np.int64))
+        )
+        if self.prefetch:
+            cells_dev = (
+                cells0 if cells0 is not None
+                else self.assign(ring[start_step % k])
+            )
+        else:
+            cells_dev = jnp.zeros((0,), jnp.int64)  # inert placeholder
+        acc_dev = jnp.asarray(_wrap_i32(acc_host).astype(np.int32))
+        meta = {
+            "n_batches": int(n_batches),
+            "batch": batch,
+            "ring_k": k,
+            "prefetch": self.prefetch,
+            "snapshot_every": int(snapshot_every),
+            "ring_sha256": ring_fp,
+            "trace": root.context.as_dict(),
+        }
+        degraded = [0]
+        counters = {"snapshots": 0}
+        outs_list: list[np.ndarray] = []
+        host = getattr(self.index, "host", None)
+        self._warm_seg_loop(
+            ring, cells_dev, start_step, int(n_batches),
+            int(snapshot_every), collect,
+        )
+        bounds = [
+            (s, min(snapshot_every, n_batches - s))
+            for s in range(start_step, int(n_batches), snapshot_every)
+        ]
+        win = _pipeline.resolve_window(window)
+        writer = _pipeline.SnapshotWriter(
+            name="stream", maxsize=max(2, 2 * win)
+        )
+        # the replay anchor: last materialized (landed) host carry
+        landed = {"acc": acc_host, "end": start_step}
+
+        def submit_snapshot(se, acc, cells):
+            def job(se=se, acc=np.asarray(acc, np.int64), cells=cells):
+                def snap():
+                    payload = self._snapshot_payload(
+                        acc, cells, extra_arrays
+                    )
+                    return _checkpoint.save_snapshot(
+                        run_dir, se, payload, meta
+                    )
+
+                with _trace.span("stream.snapshot", step=se, mode="async"):
+                    try:
+                        _dispatch.guarded_call(
+                            "stream.snapshot", snap,
+                            default_s=watchdog_default_s,
+                            policy=retry_policy,
+                        )
+                        counters["snapshots"] += 1
+                    except RetryExhausted as e:
+                        _telemetry.record(
+                            "snapshot_skipped", run_dir=run_dir,
+                            step=se, error=repr(e.last)[:200],
+                        )
+
+            if cells is not None and hasattr(cells, "copy_to_host_async"):
+                cells.copy_to_host_async()  # start the D2H now
+            writer.submit(job)
+
+        def launch(i):
+            nonlocal acc_dev, cells_dev
+            step, seg_n = bounds[i]
+            a0, c0 = acc_dev, cells_dev
+
+            def dispatch_async():
+                # async dispatch: the returned arrays are futures; the
+                # blocking pull happens at the window's drain
+                return self._seg_loop(
+                    ring, self.index, jnp.int32(step), a0, c0,
+                    nb=seg_n, collect=collect,
+                )
+
+            with _trace.span(
+                "stream.segment", step=step, n=seg_n, pipelined=True
+            ):
+                try:
+                    a, c, o = _dispatch.guarded_call(
+                        "stream.scan_step", dispatch_async,
+                        default_s=watchdog_default_s,
+                        policy=retry_policy,
+                    )
+                except RetryExhausted as e:
+                    if host is None:
+                        raise
+                    _telemetry.record(
+                        "degraded", label="stream.scan_step",
+                        step=step, attempts=e.attempts,
+                        error=repr(e.last)[:200],
+                    )
+                    # the carry chain is deterministic: pulling the
+                    # in-flight acc blocks until upstream segments
+                    # finish and yields the exact pre-segment fold
+                    a_host = np.asarray(a0, np.int64)
+                    delta, o_np = self._host_segment(
+                        ring_np, step, seg_n, collect
+                    )
+                    acc_new = _wrap_i32(a_host + delta)
+                    degraded[0] += 1
+                    acc_dev = jnp.asarray(acc_new.astype(np.int32))
+                    if self.prefetch:
+                        cells_dev = self.assign(
+                            ring[(step + seg_n) % k]
+                        )
+                    return ("host", acc_new, cells_dev, o_np)
+                acc_dev, cells_dev = a, c
+                return ("dev", a, c, o)
+
+        def land(i, handle):
+            kind, a, c, o = handle
+            step, seg_n = bounds[i]
+            se = step + seg_n
+            if kind == "dev":
+                a_np = np.asarray(a)  # blocks: the drain's one pull
+                o_np = np.asarray(o) if collect else None
+            else:
+                a_np, o_np = a, o
+            if collect and o_np is not None:
+                outs_list.append(o_np)
+            landed["acc"] = _wrap_i32(np.asarray(a_np, np.int64))
+            landed["end"] = se
+            submit_snapshot(
+                se, landed["acc"], c if self.prefetch else None
+            )
+
+        def replay(lo, hi):
+            nonlocal acc_dev, cells_dev
+            acc = landed["acc"]
+            step0 = bounds[lo][0]
+            cells = (
+                self.assign(ring[step0 % k]) if self.prefetch
+                else jnp.zeros((0,), jnp.int64)
+            )
+            for j in range(lo, hi + 1):
+                step, seg_n = bounds[j]
+                acc, cells, o_np, degr = self._segment_sync(
+                    ring, ring_np, step, seg_n, acc, cells,
+                    collect=collect,
+                    watchdog_default_s=watchdog_default_s,
+                    retry_policy=retry_policy, host=host,
+                )
+                degraded[0] += int(degr)
+                if collect and o_np is not None:
+                    outs_list.append(o_np)
+                landed["acc"] = _wrap_i32(np.asarray(acc, np.int64))
+                landed["end"] = step + seg_n
+                submit_snapshot(
+                    landed["end"], landed["acc"],
+                    cells if self.prefetch else None,
+                )
+            acc_dev = jnp.asarray(landed["acc"].astype(np.int32))
+            cells_dev = cells
+
+        t0 = time.perf_counter()
+        try:
+            pstats = _pipeline.execute_pipeline(
+                len(bounds), launch, land,
+                drain_site="stream.pipeline.drain", replay=replay,
+                window=win, watchdog_default_s=watchdog_default_s,
+            )
+            # durability barrier: a snapshot exists only once its
+            # background write completed
+            with _trace.span(
+                "stream.pipeline.flush", pending=writer.pending
+            ), _telemetry.timed("stream_stage", stage="pipeline_flush"):
+                writer.flush()
+        except BaseException:
+            # make completed snapshot writes durable, then let the
+            # original failure win — resume replays from the last
+            # COMPLETED snapshot, exactly as the synchronous loop
+            with contextlib.suppress(BaseException):
+                writer.close()
+            raise
+        writer.close()
+        wall = time.perf_counter() - t0
+        acc_w = _wrap_i32(landed["acc"])
+        n_run = int(n_batches) - start_step
+        n_points = int(n_batches) * batch
+        _telemetry.record(
+            "stream_stage", stage="durable_loop",
+            seconds=round(wall, 6), n_batches=int(n_batches),
+            batch=batch, ring_k=k, prefetch=self.prefetch,
+            snapshots=counters["snapshots"],
+            degraded_segments=degraded[0],
+            resumed_from=resumed_from, pipelined=True,
+            window=pstats.window,
+            points_per_sec=round(n_run * batch / max(wall, 1e-9), 1),
+        )
+        metrics = {
+            "degraded": degraded[0] > 0,
+            "degraded_segments": degraded[0],
+            "snapshots": counters["snapshots"],
+            "resumed_from": resumed_from,
+            "run_dir": run_dir,
+            "pipeline": pstats.as_dict(),
+        }
+        if (
+            self._last_quarantine is not None
+            and self._last_quarantine[0] == ring_fp
+        ):
+            metrics.update(self._last_quarantine[1].metrics())
+        return StreamResult(
+            checksum=int(acc_w[0]),
+            matches=int(acc_w[1]),
+            overflow=int(acc_w[2]),
+            n_points=n_points,
+            n_batches=int(n_batches),
             batch=batch,
             wall_s=wall,
             points_per_sec=n_run * batch / max(wall, 1e-9),

@@ -1,0 +1,266 @@
+"""Unit contracts for the pipelined execution core
+(`dispatch/pipeline.py`): bounded-window ordering, the replay-from-
+materialized-carry rule, best-effort drain on fatal errors, and the
+SnapshotWriter's context adoption + held-error/durability barriers.
+
+Frontend-level semantics (bit-identity, kill/resume, degradation) are
+pinned where they live: tests/test_stream_faults.py and
+tests/test_raster_zonal.py. This file pins the core's mechanics with
+synthetic launch/land callbacks, so a regression points at the
+pipeline, not at a frontend.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from mosaic_tpu.dispatch import (
+    SnapshotWriter,
+    execute_pipeline,
+    resolve_window,
+)
+from mosaic_tpu.runtime import faults, telemetry
+from mosaic_tpu.runtime.errors import TransientDeviceError
+
+
+class TestResolveWindow:
+    def test_default(self, monkeypatch):
+        monkeypatch.delenv("MOSAIC_STREAM_WINDOW", raising=False)
+        assert resolve_window() == 4
+
+    def test_env_knob(self, monkeypatch):
+        monkeypatch.setenv("MOSAIC_STREAM_WINDOW", "7")
+        assert resolve_window() == 7
+
+    def test_explicit_beats_env(self, monkeypatch):
+        monkeypatch.setenv("MOSAIC_STREAM_WINDOW", "7")
+        assert resolve_window(2) == 2
+
+    def test_clamped_to_one(self):
+        assert resolve_window(0) == 1
+        assert resolve_window(-3) == 1
+
+    def test_garbage_env_falls_back(self, monkeypatch):
+        monkeypatch.setenv("MOSAIC_STREAM_WINDOW", "many")
+        assert resolve_window() == 4
+
+
+class TestExecutePipeline:
+    def test_lands_in_order_and_counts(self):
+        landed = []
+        stats = execute_pipeline(
+            10, lambda i: i * i,
+            lambda i, h: landed.append((i, h)),
+            drain_site="t.drain", window=3,
+        )
+        assert landed == [(i, i * i) for i in range(10)]
+        assert stats.launched == 10 and stats.landed == 10
+        assert stats.max_inflight == 3
+        assert stats.replays == 0 and stats.replayed == 0
+
+    def test_window_bounds_inflight(self):
+        live = set()
+        high = [0]
+
+        def launch(i):
+            live.add(i)
+            high[0] = max(high[0], len(live))
+            return i
+
+        stats = execute_pipeline(
+            12, launch, lambda i, h: live.discard(i),
+            drain_site="t.drain", window=2,
+        )
+        assert high[0] == 2
+        assert stats.max_inflight == 2
+
+    def test_window_one_is_the_synchronous_loop(self):
+        order = []
+        execute_pipeline(
+            4, lambda i: order.append(("launch", i)),
+            lambda i, h: order.append(("land", i)),
+            drain_site="t.drain", window=1,
+        )
+        assert order == [
+            (op, i) for i in range(4) for op in ("launch", "land")
+        ]
+
+    def test_transient_drain_replays_from_materialized_carry(self):
+        landed, replays = [], []
+        boom = [True]
+
+        def land(i, h):
+            if i == 1 and boom[0]:
+                boom[0] = False
+                raise TransientDeviceError("drain hiccup")
+            landed.append(i)
+
+        with telemetry.capture() as ev:
+            stats = execute_pipeline(
+                5, lambda i: i, land, drain_site="t.drain",
+                replay=lambda lo, hi: replays.append((lo, hi)),
+                window=2,
+            )
+        # launches 0,1 -> land 0 -> launch 2 -> land 1 FAILS with
+        # items 1,2 in flight: the window is discarded and the caller
+        # replays [materialized+1 .. last launched] = [1, 2]
+        assert replays == [(1, 2)]
+        assert landed == [0, 3, 4]
+        assert stats.replays == 1 and stats.replayed == 2
+        kinds = [e["event"] for e in ev]
+        assert kinds.count("pipeline_replay") == 1
+
+    def test_transient_launch_discards_unlanded_window(self):
+        replays = []
+        boom = [True]
+
+        def launch(i):
+            if i == 1 and boom[0]:
+                boom[0] = False
+                raise TransientDeviceError("launch hiccup")
+            return i
+
+        stats = execute_pipeline(
+            3, launch, lambda i, h: None, drain_site="t.drain",
+            replay=lambda lo, hi: replays.append((lo, hi)),
+            window=4,
+        )
+        # item 0 was launched but NOT yet materialized when launch(1)
+        # failed — it is part of the poisoned window and replays too
+        assert replays == [(0, 1)]
+        assert stats.replayed == 2
+
+    def test_transient_without_replay_propagates(self):
+        def land(i, h):
+            raise TransientDeviceError("no replay path")
+
+        with pytest.raises(TransientDeviceError):
+            execute_pipeline(
+                3, lambda i: i, land, drain_site="t.drain", window=2,
+            )
+
+    def test_fatal_launch_drains_completed_work_then_raises(self):
+        landed = []
+
+        def launch(i):
+            if i == 3:
+                raise RuntimeError("simulated device loss")
+            return i
+
+        with pytest.raises(RuntimeError, match="device loss"):
+            execute_pipeline(
+                6, launch, lambda i, h: landed.append(i),
+                drain_site="t.drain", window=2,
+            )
+        # everything launched before the fatal error still lands —
+        # the durable caller's snapshots become resume points
+        assert landed == [0, 1, 2]
+
+    def test_fatal_drain_error_wins_over_best_effort(self):
+        def land(i, h):
+            raise ValueError(f"bad land {i}")
+
+        with pytest.raises(ValueError, match="bad land 0"):
+            execute_pipeline(
+                4, lambda i: i, land, drain_site="t.drain", window=2,
+            )
+
+    def test_empty_input(self):
+        stats = execute_pipeline(
+            0, lambda i: i, lambda i, h: None, drain_site="t.drain",
+        )
+        assert stats.launched == 0 and stats.landed == 0
+
+    def test_drain_emits_stage_and_span(self):
+        with telemetry.capture() as ev:
+            execute_pipeline(
+                2, lambda i: i, lambda i, h: None,
+                drain_site="t.drain", window=1,
+            )
+        stages = [
+            e for e in ev
+            if e["event"] == "stream_stage"
+            and e.get("stage") == "pipeline_drain"
+        ]
+        spans = [
+            e for e in ev
+            if e["event"] == "span"
+            and e.get("name") == "stream.pipeline.drain"
+        ]
+        assert len(stages) == 2 and len(spans) == 2
+        assert all(s["site"] == "t.drain" for s in stages)
+
+
+class TestSnapshotWriter:
+    def test_jobs_run_fifo_and_flush_is_a_barrier(self):
+        done = []
+        w = SnapshotWriter(name="t", maxsize=4)
+        for i in range(6):
+            w.submit(lambda i=i: done.append(i))
+        w.flush()
+        assert done == list(range(6))
+        assert w.pending == 0
+        w.close()
+
+    def test_worker_adopts_telemetry_sinks(self):
+        with telemetry.capture() as ev:
+            w = SnapshotWriter(name="t")
+            w.submit(lambda: telemetry.record("from_writer", ok=True))
+            w.flush()
+            w.close()
+        assert any(e["event"] == "from_writer" for e in ev)
+
+    def test_worker_shares_fault_budgets(self):
+        # the plan list is SHARED (not copied): budget consumed on the
+        # writer thread is visible to the caller — one budget, two
+        # threads, exactly like an inline write
+        with faults.transient_errors(1, sites=("t.site",)):
+            w = SnapshotWriter(name="t")
+            hits = []
+
+            def job():
+                try:
+                    faults.maybe_fail("t.site")
+                except TransientDeviceError:
+                    hits.append(1)
+
+            w.submit(job)
+            w.flush()
+            # budget of 1 was consumed by the writer thread
+            faults.maybe_fail("t.site")  # must NOT raise
+            w.close()
+        assert hits == [1]
+
+    def test_job_error_held_and_reraised_on_flush(self):
+        w = SnapshotWriter(name="t")
+        w.submit(lambda: (_ for _ in ()).throw(OSError("disk on fire")))
+        with pytest.raises(OSError, match="disk on fire"):
+            w.flush()
+        # the error does not re-raise twice
+        w.flush()
+        w.close()
+
+    def test_submit_after_close_raises(self):
+        w = SnapshotWriter(name="t")
+        w.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            w.submit(lambda: None)
+
+    def test_backpressure_blocks_submit(self):
+        gate = threading.Event()
+        w = SnapshotWriter(name="t", maxsize=1)
+        w.submit(gate.wait)  # occupies the worker
+        w.submit(lambda: None)  # fills the queue
+        t0 = time.perf_counter()
+
+        def release():
+            time.sleep(0.05)
+            gate.set()
+
+        threading.Thread(target=release).start()  # lint: thread-context-adoption-ok (test timer thread: only sets an Event, records nothing)
+        w.submit(lambda: None)  # must block until the worker drains
+        assert time.perf_counter() - t0 >= 0.04
+        w.close()

@@ -422,3 +422,163 @@ def test_backoff_jitter_deterministic_under_seed(monkeypatch):
     d4 = backoff_delays(pol, rng=_random.Random(9))
     assert [next(d3) for _ in range(5)] == [next(d4) for _ in range(5)]
     assert a  # seeded env path produced a value at all
+
+
+# ------------------------------------------------- pipelined executor
+
+
+class TestPipelinedDurable:
+    """The ISSUE-14 contract: the asynchronous pipelined mode
+    (`dispatch/pipeline.py`) is bit-identical to the synchronous loop
+    under EVERY fault plan the synchronous matrix pins — same sites,
+    same budgets, same degradation — plus kill-at-every-boundary +
+    resume. The pipeline changes wall time, never the answer."""
+
+    def test_pipelined_equals_plain_and_sync(self, sj, ring, clean,
+                                             tmp_path):
+        r = sj.run_durable(
+            ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+            retry_policy=FAST, pipeline=True,
+        )
+        assert _stats(r) == _stats(clean)
+        assert r.metrics["degraded"] is False
+        assert r.metrics["snapshots"] == 4  # boundaries 2, 4, 6, 7
+        assert checkpoint.list_snapshots(str(tmp_path)) == [2, 4, 6, 7]
+        p = r.metrics["pipeline"]
+        assert p["launched"] == 4 and p["landed"] == 4
+        assert p["window"] >= 1
+
+    def test_pipelined_collect_outs_bit_identical(self, sj, ring, clean,
+                                                  tmp_path):
+        r = sj.run_durable(
+            ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+            retry_policy=FAST, pipeline=True, collect=True,
+        )
+        np.testing.assert_array_equal(r.outs, clean.outs)
+
+    def test_pipelined_non_prefetch_equals_plain(self, index, ring,
+                                                 clean, tmp_path):
+        sj0 = StreamJoin(index, CUSTOM, RES, prefetch=False)
+        r = sj0.run_durable(
+            ring, NB, run_dir=str(tmp_path), snapshot_every=3,
+            retry_policy=FAST, pipeline=True,
+        )
+        assert _stats(r) == _stats(clean)
+
+    def test_env_knob_selects_pipelined_mode(self, sj, ring, clean,
+                                             tmp_path, monkeypatch):
+        monkeypatch.setenv("MOSAIC_STREAM_PIPELINE", "1")
+        monkeypatch.setenv("MOSAIC_STREAM_WINDOW", "2")
+        r = sj.run_durable(
+            ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+            retry_policy=FAST,
+        )
+        assert _stats(r) == _stats(clean)
+        assert r.metrics["pipeline"]["window"] == 2
+
+    @pytest.mark.parametrize("kill_after", [1, 2, 3])
+    def test_kill_and_resume_bit_identical(self, sj, ring, clean,
+                                           tmp_path, kill_after):
+        """Fatal device loss mid-flight: the pipeline's best-effort
+        drain makes every already-launched segment durable, so the
+        newest snapshot is exactly the kill boundary — and a PIPELINED
+        resume converges to the clean stats bit for bit."""
+        d = str(tmp_path / f"kill{kill_after}")
+        with faults.inject(
+            fail_first=99, skip_first=kill_after,
+            sites=("stream.scan_step",),
+            exc_factory=lambda s: RuntimeError(
+                f"simulated device loss @ {s}"
+            ),
+        ):
+            with pytest.raises(RuntimeError, match="simulated device loss"):
+                sj.run_durable(
+                    ring, NB, run_dir=d, snapshot_every=SNAP,
+                    retry_policy=FAST, pipeline=True,
+                )
+        assert checkpoint.list_snapshots(d)
+        r = sj.resume(d, ring, retry_policy=FAST, pipeline=True)
+        assert _stats(r) == _stats(clean)
+        assert r.metrics["resumed_from"] == kill_after * SNAP
+
+    def test_transient_faults_retry_to_clean(self, sj, ring, clean,
+                                             tmp_path):
+        with telemetry.capture() as ev:
+            with faults.transient_errors(2, sites=("stream.scan_step",)):
+                r = sj.run_durable(
+                    ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                    retry_policy=FAST, pipeline=True,
+                )
+        assert _stats(r) == _stats(clean)
+        assert r.metrics["degraded"] is False
+        assert [e["event"] for e in ev].count("transient_retry") == 2
+
+    def test_exhausted_segment_degrades_to_host_oracle(self, sj, ring,
+                                                       clean, tmp_path):
+        with telemetry.capture() as ev:
+            with faults.transient_errors(3, sites=("stream.scan_step",)):
+                r = sj.run_durable(
+                    ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                    retry_policy=FAST, pipeline=True,
+                )
+        assert _stats(r) == _stats(clean)
+        assert r.metrics["degraded"] is True
+        assert r.metrics["degraded_segments"] == 1
+        assert "degraded" in [e["event"] for e in ev]
+
+    def test_snapshot_failure_does_not_kill_run(self, sj, ring, clean,
+                                                tmp_path):
+        """Sick disk with the writes on the BACKGROUND thread: the
+        adopted fault plans trip inside the writer's guarded call,
+        every boundary degrades to ``snapshot_skipped``, and the run
+        still answers exactly."""
+        with telemetry.capture() as ev:
+            with faults.transient_errors(
+                999, sites=("stream.snapshot",)
+            ):
+                r = sj.run_durable(
+                    ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                    retry_policy=FAST, pipeline=True,
+                )
+        assert _stats(r) == _stats(clean)
+        assert r.metrics["snapshots"] == 0
+        skipped = [e for e in ev if e["event"] == "snapshot_skipped"]
+        assert len(skipped) == 4
+
+    def test_watchdog_stall_recovered_by_retry(self, sj, ring, clean,
+                                               tmp_path, monkeypatch):
+        monkeypatch.setenv("MOSAIC_WATCHDOG_STREAM_SCAN_STEP", "0.15")
+        with telemetry.capture() as ev:
+            with faults.stalls(1.2, n=1, sites=("stream.scan_step",)):
+                r = sj.run_durable(
+                    ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                    retry_policy=FAST, pipeline=True,
+                )
+        assert _stats(r) == _stats(clean)
+        assert r.metrics["degraded"] is False
+        kinds = [e["event"] for e in ev]
+        assert "watchdog_stall" in kinds
+        assert "transient_retry" in kinds
+
+    def test_snapshot_spans_marked_async(self, sj, ring, tmp_path):
+        """The writer thread emits the same ``stream.snapshot`` spans
+        (adopted trace context: same trail, same parentage rules) with
+        ``mode="async"`` so the timeline can tell the two shapes
+        apart."""
+        with telemetry.capture() as ev:
+            sj.run_durable(
+                ring, NB, run_dir=str(tmp_path), snapshot_every=SNAP,
+                retry_policy=FAST, pipeline=True,
+            )
+        snaps = [
+            e for e in ev
+            if e["event"] == "span" and e.get("name") == "stream.snapshot"
+        ]
+        assert len(snaps) == 4
+        assert all(s.get("mode") == "async" for s in snaps)
+        flushes = [
+            e for e in ev
+            if e["event"] == "span"
+            and e.get("name") == "stream.pipeline.flush"
+        ]
+        assert len(flushes) == 1

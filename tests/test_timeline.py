@@ -63,6 +63,10 @@ class TestKeysAndClasses:
         ("span.join.probe.scatter", "device"),
         ("probe_stage.heavy", "device"),
         ("raster_stage.zonal", "device"),
+        ("span.stream.pipeline.drain", "device"),
+        ("stream_stage.pipeline_drain", "device"),
+        ("span.stream.pipeline.flush", "host_callback"),
+        ("stream_stage.pipeline_flush", "host_callback"),
     ])
     def test_classifier_table(self, key, cls):
         assert timeline.classify_key(key) == cls
@@ -181,6 +185,117 @@ class TestTracks:
         b = [(0.5, 2.5)]
         assert timeline.overlap_s(a, b) == pytest.approx(1.0)
         assert timeline.overlap_s(a, [(5.0, 6.0)]) == 0.0
+
+
+class TestOverlappedTimelines:
+    """The pipelined executor's claim as interval arithmetic: snapshot
+    ``host_callback`` intervals that genuinely OVERLAP ``device``
+    intervals (the writer thread runs while the next segments compute)
+    must still flatten to an exact partition, and the pinned
+    ``overlap_fraction`` helper turns "off the critical path" into a
+    number the bench and CI lanes can gate."""
+
+    def test_overlapping_snapshot_partition_still_exact(self):
+        # device busy 0..2 (two back-to-back segments); the async
+        # snapshot write covers 0.5..1.5 ENTIRELY inside device time —
+        # the pipelined shape a synchronous loop can never produce
+        evts = [
+            _span("stream.segment", 0.0, 1.0, seq=1),
+            _span("stream.segment", 1.0, 1.0, seq=2),
+            _span("stream.snapshot", 0.5, 1.0, seq=3, mode="async"),
+        ]
+        segs = timeline.flatten(timeline.intervals(evts), (0.0, 2.0))
+        total = sum(s["end"] - s["start"] for s in segs)
+        assert total == pytest.approx(2.0, abs=1e-9)
+        by_cls = {}
+        for s in segs:
+            by_cls[s["cls"]] = by_cls.get(s["cls"], 0.0) + (
+                s["end"] - s["start"]
+            )
+        # host_callback outranks device for the overlapped second;
+        # nothing is double-counted and nothing leaks to idle
+        assert by_cls["host_callback"] == pytest.approx(1.0)
+        assert by_cls["device"] == pytest.approx(1.0)
+        assert "idle" not in by_cls
+
+    def test_drain_and_flush_classes_sweep_exactly(self):
+        # drain (device: the window's one blocking pull) overlapping
+        # the writer's flush barrier (host_callback) at the run tail
+        evts = [
+            _span("stream.pipeline.drain", 0.0, 1.0, seq=1),
+            _span("stream.pipeline.flush", 0.8, 0.6, seq=2),
+        ]
+        segs = timeline.flatten(timeline.intervals(evts), (0.0, 1.5))
+        total = sum(s["end"] - s["start"] for s in segs)
+        assert total == pytest.approx(1.5, abs=1e-9)
+        by_cls = {
+            s["cls"]: sum(
+                x["end"] - x["start"] for x in segs
+                if x["cls"] == s["cls"]
+            )
+            for s in segs
+        }
+        assert by_cls["device"] == pytest.approx(0.8)
+        assert by_cls["host_callback"] == pytest.approx(0.6)
+        assert by_cls.get("idle", 0.1) == pytest.approx(0.1)
+
+    def test_overlap_fraction_pinned(self):
+        dev = [(0.0, 1.0), (2.0, 3.0)]
+        # fully hidden under device -> 1.0
+        assert timeline.overlap_fraction([(0.2, 0.8)], dev) == 1.0
+        # serialized after device (the synchronous loop) -> 0.0
+        assert timeline.overlap_fraction([(1.0, 2.0)], dev) == 0.0
+        # half in, half out
+        assert timeline.overlap_fraction(
+            [(0.5, 1.5)], dev
+        ) == pytest.approx(0.5)
+        # empty snapshot track -> 0.0, never a ZeroDivisionError
+        assert timeline.overlap_fraction([], dev) == 0.0
+
+    def test_pipelined_run_emits_drain_intervals(self, tmp_path):
+        from mosaic_tpu.core.geometry import wkt
+        from mosaic_tpu.core.index import CustomIndexSystem, GridConf
+        from mosaic_tpu.core.tessellate import tessellate
+        from mosaic_tpu.sql.join import build_chip_index
+        from mosaic_tpu.sql.stream import StreamJoin, ring_from_host
+
+        grid = CustomIndexSystem(
+            GridConf(-180, 180, -90, 90, 2, 10.0, 10.0)
+        )
+        col = wkt.from_wkt(
+            ["POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1))"]
+        )
+        index = build_chip_index(
+            tessellate(col, grid, 3, keep_core_geoms=False)
+        )
+        rng = np.random.default_rng(0)
+        sj = StreamJoin(index, grid, 3, prefetch=True)
+        ring = ring_from_host(
+            [rng.uniform((-25, -25), (35, 20), (2048, 2))
+             for _ in range(3)]
+        )
+        with telemetry.capture() as events:
+            sj.run_durable(
+                ring, 6, run_dir=str(tmp_path), snapshot_every=2,
+                pipeline=True,
+            )
+        rep = timeline.attribute(events)
+        assert rep["window"]["source"] == "stream_stage.durable_loop"
+        # the partition invariant holds for a REAL overlapped trail
+        # (writer-thread snapshot spans + main-thread drain spans)
+        assert abs(rep["sum_s"] - rep["wall_s"]) <= 0.05 * rep["wall_s"]
+        tracks = timeline.build_tracks(events)
+        assert "span.stream.pipeline.drain" in tracks
+        assert tracks["span.stream.pipeline.drain"]["count"] == 3
+        assert "span.stream.snapshot" in tracks
+        # the helper runs end to end on real tracks (the value itself
+        # is timing-dependent on CPU; the bench pins the A/B claim)
+        frac = timeline.overlap_fraction(
+            tracks["span.stream.snapshot"]["intervals"],
+            tracks["span.stream.pipeline.drain"]["intervals"]
+            + tracks["span.stream.segment"]["intervals"],
+        )
+        assert 0.0 <= frac <= 1.0
 
 
 # ------------------------------------------------ real durable stream

@@ -39,6 +39,7 @@ PATTERNS = (
     "STREAM_*.json",
     "MULTICHIP_r*.json",
     "RASTER_r*.json",
+    "STALL_r*.json",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)$")
@@ -51,6 +52,28 @@ def _lane_and_round(stem: str, doc: dict) -> tuple[str, object]:
     if isinstance(doc.get("n"), int):
         return stem, doc["n"]
     return stem, "live" if "LIVE" in stem else None
+
+
+def _sustained(doc: dict) -> float | None:
+    """The sustained-rate fraction of single-batch carried by an
+    artifact, from whichever shape holds it: a stream bench line
+    (``detail.pipeline.sustained_frac_of_single`` when the pipelined
+    A/B ran, else ``detail.sustained_frac_of_single``) or a stall
+    report (``loss.sustained_frac``)."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc or "tail" in doc:  # driver wrapper
+        doc = doc.get("parsed")
+        if not isinstance(doc, dict):
+            return None
+    det = doc.get("detail") or {}
+    for holder in (det.get("pipeline"), det, doc.get("loss")):
+        if isinstance(holder, dict):
+            v = holder.get("sustained_frac_of_single",
+                           holder.get("sustained_frac"))
+            if isinstance(v, (int, float)):
+                return float(v)
+    return None
 
 
 def _headline(doc: dict) -> dict | None:
@@ -73,6 +96,7 @@ def _headline(doc: dict) -> dict | None:
 def collect(root: str) -> dict:
     lanes: dict = {}
     skipped: list = []
+    sustained: list = []
     seen = set()
     for pat in PATTERNS:
         for path in sorted(glob.glob(os.path.join(root, pat))):
@@ -88,18 +112,38 @@ def collect(root: str) -> dict:
                 skipped.append({"file": fname, "reason": repr(e)[:120]})
                 continue
             lane, rnd = _lane_and_round(stem, doc)
+            # main-lane series only: the STREAM_HOST/STREAM_1B
+            # variants measure different configurations and would
+            # put incomparable points at the same round
+            sv = (
+                _sustained(doc)
+                if lane in ("STREAM", "STREAM_CPU", "STALL")
+                else None
+            )
+            if sv is not None:
+                sustained.append({
+                    "round": rnd, "file": fname,
+                    "metric": "sustained_frac_of_single",
+                    "value": sv, "unit": "frac",
+                })
             head = _headline(doc)
             if head is None:
-                skipped.append({
-                    "file": fname,
-                    "reason": "no parseable {metric,value} headline "
-                              f"(rc={doc.get('rc')})"
-                    if isinstance(doc, dict) else "not an object",
-                })
+                if sv is None:
+                    skipped.append({
+                        "file": fname,
+                        "reason": "no parseable {metric,value} headline"
+                                  f" (rc={doc.get('rc')})"
+                        if isinstance(doc, dict) else "not an object",
+                    })
                 continue
             lanes.setdefault(lane, []).append({
                 "round": rnd, "file": fname, **head,
             })
+    if sustained:
+        # cross-lane series: every committed artifact that measures
+        # sustained-vs-single (STREAM bench lines, STALL reports) in
+        # one trajectory — the gap-closing story in a single row
+        lanes["sustained_frac_of_single"] = sustained
     out = {}
     for lane, pts in sorted(lanes.items()):
         pts.sort(

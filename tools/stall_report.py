@@ -150,8 +150,20 @@ def build_report(events) -> dict | None:
     return report
 
 
+def load_baseline(path: str) -> dict | None:
+    """A baseline for ``--against``: either a raw trail (rebuilt into a
+    report) or a committed ``stall_report`` artifact (used as-is), so
+    cross-PR comparisons work from the repo-root JSON without the
+    original trail."""
+    rows = export.read_trail(path)
+    if len(rows) == 1 and rows[0].get("metric") == "stall_report":
+        return rows[0]
+    return build_report(rows)
+
+
 def diff_reports(fresh: dict, base: dict) -> dict:
-    """Per-class share/seconds deltas between two reports."""
+    """Per-class share/seconds deltas between two reports, plus the
+    sustained-vs-single loss deltas when both sides carry one."""
     out = {}
     keys = set(fresh["classes"]) | set(base["classes"])
     for c in sorted(keys):
@@ -160,6 +172,21 @@ def diff_reports(fresh: dict, base: dict) -> dict:
         out[c] = {
             "seconds": round(f["seconds"] - b["seconds"], 6),
             "share": round(f["share"] - b["share"], 4),
+        }
+    fl, bl = fresh.get("loss"), base.get("loss")
+    if fl and bl:
+        out["loss"] = {
+            "sustained_frac": round(
+                fl["sustained_frac"] - bl["sustained_frac"], 4
+            ),
+            "sustained_frac_ratio": (
+                round(fl["sustained_frac"] / bl["sustained_frac"], 3)
+                if bl["sustained_frac"] else None
+            ),
+            "device_excess": round(
+                fl["loss_classes"]["device_excess"]
+                - bl["loss_classes"]["device_excess"], 6
+            ),
         }
     return out
 
@@ -206,7 +233,8 @@ def main() -> int:
     ap.add_argument("trail", help="JSONL trail or bench artifact")
     ap.add_argument(
         "--against", default=None,
-        help="baseline trail to diff class shares against",
+        help="baseline to diff class shares (and loss decomposition) "
+             "against: a trail, or a committed stall_report artifact",
     )
     ap.add_argument(
         "--out", default=None,
@@ -232,7 +260,7 @@ def main() -> int:
         return 1
 
     if args.against:
-        base = build_report(export.read_trail(args.against))
+        base = load_baseline(args.against)
         if base is not None:
             report["diff"] = diff_reports(report, base)
             report["against"] = args.against

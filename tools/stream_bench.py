@@ -74,6 +74,13 @@ def main() -> None:
     ap.add_argument("--durable", action="store_true",
                     help="run the ring loop through run_durable "
                     "(checkpoint/resume, watchdog, retry+degradation)")
+    ap.add_argument("--pipeline", action="store_true",
+                    help="A/B the pipelined durable executor "
+                    "(dispatch/pipeline.py) against the synchronous "
+                    "segment loop: emits detail.pipeline with the "
+                    "sustained-rate delta, window depth, and the "
+                    "snapshot/device overlap fraction (implies "
+                    "--durable)")
     ap.add_argument("--resume", action="store_true",
                     help="resume an interrupted --durable run from "
                     "--run-dir instead of starting fresh")
@@ -241,6 +248,8 @@ def main() -> None:
             # demo) and the checkpointed segment loop — slower than the
             # one-dispatch loop (one snapshot D2H per segment), priced
             # separately in detail.durable, never the headline
+            if args.pipeline:
+                args.durable = True
             if args.poison or args.durable or args.resume:
                 host_batches = [np.array(b) for b in np.asarray(ring)]
                 if args.poison:
@@ -264,7 +273,55 @@ def main() -> None:
                     checksum=res_d.checksum,
                     matches=res_d.matches,
                     overflow=res_d.overflow,
+                    sustained_frac_of_single=round(
+                        res_d.points_per_sec / single_rate, 4
+                    ),
                 )
+                # (2c) pipelined A/B: the same durable workload through
+                # the asynchronous executor — the trail slice gives the
+                # snapshot/device overlap fraction ("snapshots off the
+                # critical path" as a measured number, not prose)
+                if args.pipeline and not args.resume:
+                    from mosaic_tpu.obs import timeline as _tl
+
+                    i0 = len(stages)
+                    res_p = sj.run_durable(
+                        ring, n_batches, run_dir=run_dir + "_pipe",
+                        snapshot_every=args.snapshot_every,
+                        extra_arrays={"gen_key": np.asarray(key)},
+                        pipeline=True,
+                    )
+                    tracks = _tl.build_tracks(stages[i0:])
+
+                    def _iv(key_):
+                        return tracks.get(key_, {}).get("intervals", [])
+
+                    sync_rate = res_d.points_per_sec
+                    pipe_rate = res_p.points_per_sec
+                    detail["pipeline"] = dict(
+                        res_p.metrics.get("pipeline", {}),
+                        points_per_sec=round(pipe_rate, 1),
+                        wall_s=round(res_p.wall_s, 3),
+                        sustained_frac_of_single=round(
+                            pipe_rate / single_rate, 4
+                        ),
+                        sustained_frac_delta_vs_sync=round(
+                            (pipe_rate - sync_rate) / single_rate, 4
+                        ),
+                        speedup_vs_sync=round(
+                            pipe_rate / max(sync_rate, 1e-9), 3
+                        ),
+                        snapshot_overlap_fraction=_tl.overlap_fraction(
+                            _iv("span.stream.snapshot"),
+                            _iv("span.stream.pipeline.drain")
+                            + _iv("span.stream.segment"),
+                        ),
+                        consistent_with_sync=bool(
+                            res_p.checksum == res_d.checksum
+                            and res_p.matches == res_d.matches
+                            and res_p.overflow == res_d.overflow
+                        ),
+                    )
 
             # (3) the join loop over the ring, prefetch on — ONE
             # dispatch, one (3,) result pull (per-batch python dispatch
@@ -361,10 +418,17 @@ def main() -> None:
                     n_batches * batch / fw, 1
                 )
 
-            # (6) high-water memory AFTER the loop (cumulative peak)
+            # (6) high-water memory AFTER the loop (cumulative peak) —
+            # every lane must report a REAL number: the census fallback
+            # always sees at least the ring, so 0 is a measurement bug
+            # (STREAM_r05's peak_hbm_bytes: 0), never a valid artifact
             peak, src = hbm_peak(dev, fallback_arrays=[ring])
             detail["peak_hbm_bytes"] = peak
             detail["hbm_source"] = src
+            assert peak > 0, (
+                f"peak_hbm_bytes must be > 0 (source={src!r}) — the "
+                "live-buffer census fallback should at least see the ring"
+            )
 
             # (7) bit-identity against the per-batch path (CPU CI)
             if args.verify:
@@ -426,9 +490,13 @@ def main() -> None:
                 overflow=int(acc_np[2]),
                 checksum=int(acc_np[0]),
             )
-            peak, src = hbm_peak(dev)
+            peak, src = hbm_peak(dev, fallback_arrays=[nxt])
             detail["peak_hbm_bytes"] = peak
             detail["hbm_source"] = src
+            assert peak > 0, (
+                f"peak_hbm_bytes must be > 0 (source={src!r}) — the "
+                "census fallback should at least see the staged batch"
+            )
         root_span.end()
         cap_events.__exit__(None, None, None)
     except Exception as e:  # the artifact line must still parse
@@ -480,7 +548,9 @@ def main() -> None:
     if args.out:
         with open(args.out, "w") as f:
             f.write(out + "\n")
-    if detail.get("error") and not line["value"]:
+    if detail.get("error") and (
+        not line["value"] or detail.get("peak_hbm_bytes", 1) <= 0
+    ):
         sys.exit(1)
 
 
