@@ -20,7 +20,14 @@ frontend (`StreamJoin.run_durable` rides it for segments,
 - **land** materializes the oldest in-flight item (the blocking pulls
   live here). The watchdog guards this *drain* point rather than each
   hop — with a window of W items, segment i's pull overlaps segments
-  i+1..i+W's device compute instead of serializing after it.
+  i+1..i+W's device compute instead of serializing after it. Because
+  `runtime.watchdog.guard` ABANDONS (does not cancel) its worker
+  thread on deadline, the guarded ``land`` must be side-effect-free:
+  an abandoned worker may still run to completion, and any effect it
+  applied would double with the replay. Effects (accumulator folds,
+  output appends, snapshot submission) belong in the separate
+  ``commit`` callback, which runs on the caller thread only after the
+  guarded pull returned — a timed-out pull therefore commits nothing.
 - **replay** is the transient-failure contract: a stall or tunnel drop
   surfacing at the drain poisons everything in flight, so the pipeline
   discards the window and replays ``[last materialized + 1, last
@@ -110,6 +117,7 @@ def execute_pipeline(
     land,
     *,
     drain_site: str,
+    commit=None,
     replay=None,
     window: "int | None" = None,
     watchdog_default_s: "float | None" = None,
@@ -117,21 +125,31 @@ def execute_pipeline(
     """Run items 0..n_items-1 through a bounded asynchronous pipeline.
 
     ``launch(i) -> handle`` dispatches item ``i`` (async, no host
-    pull); ``land(i, handle)`` materializes it (ordered: item i always
-    lands before i+1). At most ``window`` items are in flight; when the
-    window is full the oldest item is landed under the ``drain_site``
-    watchdog deadline (`runtime/watchdog.py` env resolution) — the
-    drain is the pipeline's one blocking hop, so it is the one the
-    watchdog guards.
+    pull); ``land(i, handle) -> pulled`` materializes it (ordered:
+    item i always lands before i+1). At most ``window`` items are in
+    flight; when the window is full the oldest item is landed under
+    the ``drain_site`` watchdog deadline (`runtime/watchdog.py` env
+    resolution) — the drain is the pipeline's one blocking hop, so it
+    is the one the watchdog guards.
+
+    ``land`` MUST be side-effect-free: the watchdog abandons (does not
+    cancel) its worker thread on deadline, so an abandoned ``land``
+    may still finish after its item was replayed — any effect it
+    applied would be applied twice. State mutation belongs in
+    ``commit(i, pulled)``, which runs on the caller thread after the
+    guarded pull returned; the replay anchor only advances once
+    ``commit`` returns, so a ``commit`` that raises a transient
+    replays its own item rather than skipping or double-applying it.
 
     A *transient* failure (``runtime.errors.is_transient``: tunnel
-    drops, typed stalls) at launch or drain discards the in-flight
-    window and calls ``replay(lo, hi)`` — the caller re-runs items
-    ``lo..hi`` (inclusive) synchronously from its last materialized
-    carry, with its own guarded retry/degradation semantics — then
-    pipelining resumes after ``hi``. With no ``replay`` callback the
-    failure propagates. Non-transient errors drain already-launched
-    items best-effort (completed work becomes durable) and re-raise.
+    drops, typed stalls) at launch, drain, or commit discards the
+    in-flight window and calls ``replay(lo, hi)`` — the caller re-runs
+    items ``lo..hi`` (inclusive) synchronously from its last
+    materialized carry, with its own guarded retry/degradation
+    semantics — then pipelining resumes after ``hi``. With no
+    ``replay`` callback the failure propagates. Non-transient errors
+    drain already-launched items best-effort (completed work becomes
+    durable) and re-raise.
     """
     win = resolve_window(window)
     stats = PipelineStats(window=win)
@@ -165,10 +183,15 @@ def execute_pipeline(
             "stream_stage", stage="pipeline_drain", item=j,
             site=drain_site,
         ):
-            _core.guarded_call(
+            pulled = _core.guarded_call(
                 drain_site, land, j, handle,
                 default_s=watchdog_default_s, retry=False,
             )
+            # effects run on THIS thread, only after the guarded pull
+            # returned — a deadline leaves an abandoned worker that
+            # committed nothing, so the replay cannot double-apply j
+            if commit is not None:
+                commit(j, pulled)
         inflight.popleft()
         materialized = j
         stats.landed += 1
@@ -290,6 +313,18 @@ class SnapshotWriter:
         if flush and self._thread.is_alive():
             self._q.join()
         if self._thread.is_alive():
+            if not flush:
+                # abandon for real: pull queued jobs off the queue so
+                # the STOP marker isn't FIFO-ordered behind them (and
+                # so put() below cannot block on a full queue). Best
+                # effort — a job the worker already grabbed still runs.
+                while True:
+                    try:
+                        self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    self._submitted -= 1
+                    self._q.task_done()
             self._q.put(_STOP)
             self._thread.join()
         if flush:

@@ -309,7 +309,8 @@ class RasterStream:
         # tiles ride the pipelined execution core: launch dispatches
         # tile t's fold WITHOUT the blocking pull (the probe's host
         # patch still completes here — it is host work by construction),
-        # the ordered drain materializes + accumulates, so the fold
+        # the ordered drain pulls the partials under the watchdog and
+        # the caller-thread commit accumulates them, so the fold
         # order — and therefore the float result, bit for bit — is the
         # synchronous loop's. Fault plans trip inside the launch guard
         # (the watchdog runs maybe_fail under the retry wrapper):
@@ -341,11 +342,11 @@ class RasterStream:
                 "raster.zonal", step=t, n=1, pipelined=True
             ):
                 try:
-                    return _dispatch.guarded_call(
+                    return ("dev", _dispatch.guarded_call(
                         "raster.zonal", dispatch,
                         default_s=watchdog_default_s,
                         policy=retry_policy,
-                    )
+                    ))
                 except RetryExhausted as e:
                     if host is None:
                         raise
@@ -354,36 +355,57 @@ class RasterStream:
                         attempts=e.attempts,
                         error=repr(e.last)[:200],
                     )
-                    degraded[0] += 1
                     if expr is None:
-                        return zonal.host_zone_partial(
+                        return ("host", zonal.host_zone_partial(
                             zonal.host_tile_centers(plan, t),
                             vals[t].reshape(-1),
                             mask[t].reshape(-1),
                             host, self.index_system,
                             self.resolution, g,
-                        )
-                    return _expr.host_expr_tile_partial(
+                        ))
+                    return ("host", _expr.host_expr_tile_partial(
                         value, vals[t], mask[t],
                         zonal.host_tile_centers(plan, t),
                         index_system=self.index_system,
                         resolution=self.resolution,
                         host=host, num_segments=g,
                         by="zones",
-                    )
+                    ))
 
         def land(i, handle):
+            # runs under the drain watchdog, whose deadline ABANDONS
+            # the worker thread — pull ALL four partials here and
+            # mutate nothing, so a worker finishing late changes
+            # nothing and a mid-pull transient replays a tile whose
+            # effects were never applied
+            kind, (cnt, s, mn, mx) = handle
+            return (
+                kind,
+                np.asarray(cnt, np.int64),  # blocks: the drain's pull
+                np.asarray(s, np.float64),
+                np.asarray(mn, np.float64),
+                np.asarray(mx, np.float64),
+            )
+
+        def commit(i, pulled):
             nonlocal cnt_acc, sum_acc
-            cnt, s, mn, mx = handle
-            cnt = np.asarray(cnt, np.int64)  # blocks: the drain's pull
+            kind, cnt, s, mn, mx = pulled
+            if kind == "host":
+                # degradation counts at materialization, not launch —
+                # a degraded in-flight tile later discarded by a
+                # transient is re-run (and counted once) by the replay
+                degraded[0] += 1
             live = cnt > 0
             cnt_acc += cnt
-            sum_acc = sum_acc + np.asarray(s, np.float64)
-            mn = np.asarray(mn, np.float64)
-            mx = np.asarray(mx, np.float64)
+            sum_acc = sum_acc + s
             min_acc[live] = np.minimum(min_acc[live], mn[live])
             max_acc[live] = np.maximum(max_acc[live], mx[live])
             se = start + i + 1
+            # the snapshot write runs here on the caller thread —
+            # outside the drain-watchdog deadline, like the
+            # synchronous loop — and swallows its own failures, so
+            # nothing after the accumulator fold can raise a
+            # transient that would replay (and double-count) the tile
             if run_dir is not None and (
                 (se - start) % snapshot_every == 0 or se == plan.ntiles
             ):
@@ -405,16 +427,17 @@ class RasterStream:
 
         def replay(lo, hi):
             # tiles carry no cross-tile device state, so the
-            # synchronous path IS launch + immediate land — the full
+            # synchronous path IS launch + pull + commit — the full
             # guarded retry/degradation budget applies per tile
             for j in range(lo, hi + 1):
-                land(j, launch(j))
+                commit(j, land(j, launch(j)))
 
         t0 = time.perf_counter()
         pstats = _pipeline.execute_pipeline(
             plan.ntiles - start, launch, land,
-            drain_site="raster.pipeline.drain", replay=replay,
-            window=win, watchdog_default_s=watchdog_default_s,
+            drain_site="raster.pipeline.drain", commit=commit,
+            replay=replay, window=win,
+            watchdog_default_s=watchdog_default_s,
         )
         degraded_tiles = degraded[0]
         snapshots = counters["snapshots"]

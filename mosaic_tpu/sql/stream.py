@@ -1252,7 +1252,6 @@ class StreamJoin:
                         ring_np, step, seg_n, collect
                     )
                     acc_new = _wrap_i32(a_host + delta)
-                    degraded[0] += 1
                     acc_dev = jnp.asarray(acc_new.astype(np.int32))
                     if self.prefetch:
                         cells_dev = self.assign(
@@ -1263,21 +1262,38 @@ class StreamJoin:
                 return ("dev", a, c, o)
 
         def land(i, handle):
+            # runs under the drain watchdog, whose deadline ABANDONS
+            # the worker thread — pulls only, no state mutation (an
+            # abandoned worker finishing late must change nothing)
             kind, a, c, o = handle
-            step, seg_n = bounds[i]
-            se = step + seg_n
             if kind == "dev":
                 a_np = np.asarray(a)  # blocks: the drain's one pull
                 o_np = np.asarray(o) if collect else None
             else:
                 a_np, o_np = a, o
+            return (kind, a_np, o_np, c)
+
+        def commit(i, pulled):
+            kind, a_np, o_np, c = pulled
+            step, seg_n = bounds[i]
+            se = step + seg_n
+            acc_w = _wrap_i32(np.asarray(a_np, np.int64))
+            # submit before touching the anchor: copy_to_host_async or
+            # a held writer error can raise here, and the replay must
+            # then re-apply this segment from the PRE-segment carry
+            submit_snapshot(se, acc_w, c if self.prefetch else None)
+            if kind == "host":
+                # degradation counts at materialization, not launch —
+                # a degraded in-flight segment later discarded by a
+                # transient is re-run (and counted once) by the replay
+                degraded[0] += 1
             if collect and o_np is not None:
                 outs_list.append(o_np)
-            landed["acc"] = _wrap_i32(np.asarray(a_np, np.int64))
+            # anchor update is the final statement: nothing after the
+            # submit can fail, so the anchor never runs ahead of the
+            # effects it stands for
+            landed["acc"] = acc_w
             landed["end"] = se
-            submit_snapshot(
-                se, landed["acc"], c if self.prefetch else None
-            )
 
         def replay(lo, hi):
             nonlocal acc_dev, cells_dev
@@ -1311,8 +1327,9 @@ class StreamJoin:
         try:
             pstats = _pipeline.execute_pipeline(
                 len(bounds), launch, land,
-                drain_site="stream.pipeline.drain", replay=replay,
-                window=win, watchdog_default_s=watchdog_default_s,
+                drain_site="stream.pipeline.drain", commit=commit,
+                replay=replay, window=win,
+                watchdog_default_s=watchdog_default_s,
             )
             # durability barrier: a snapshot exists only once its
             # background write completed

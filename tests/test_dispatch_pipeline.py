@@ -133,6 +133,76 @@ class TestExecutePipeline:
         assert replays == [(0, 1)]
         assert stats.replayed == 2
 
+    def test_commit_runs_on_caller_thread_with_pulled_value(self):
+        # the guarded pull runs on the watchdog worker (a deadline is
+        # set), but commit — where effects live — must run on the
+        # caller thread, only after the pull returned
+        main = threading.get_ident()
+        land_threads, commits = [], []
+
+        def land(i, h):
+            land_threads.append(threading.get_ident())
+            return h * 10
+
+        def commit(i, pulled):
+            commits.append((i, pulled, threading.get_ident()))
+
+        execute_pipeline(
+            3, lambda i: i, land, commit=commit,
+            drain_site="t.drain", window=2, watchdog_default_s=30.0,
+        )
+        assert [(i, p) for i, p, _ in commits] == [(0, 0), (1, 10), (2, 20)]
+        assert all(t == main for _, _, t in commits)
+        assert all(t != main for t in land_threads)
+
+    def test_transient_commit_replays_own_item(self):
+        # the replay anchor advances only after commit returns: a
+        # transient mid-commit replays the SAME item from the pre-item
+        # carry — it is neither skipped nor applied twice
+        commits, replays = [], []
+        boom = [True]
+
+        def commit(i, pulled):
+            if i == 1 and boom[0]:
+                boom[0] = False
+                raise TransientDeviceError("commit hiccup")
+            commits.append(i)
+
+        stats = execute_pipeline(
+            5, lambda i: i, lambda i, h: h, commit=commit,
+            drain_site="t.drain",
+            replay=lambda lo, hi: replays.append((lo, hi)), window=2,
+        )
+        assert replays == [(1, 2)]
+        assert commits == [0, 3, 4]
+        assert stats.replays == 1 and stats.replayed == 2
+
+    def test_deadline_abandoned_land_commits_nothing(self):
+        # the watchdog ABANDONS its worker on deadline: the stalled
+        # pull eventually finishes in the background, but its item was
+        # already replayed — commit must never run for it, or the
+        # item's effects would double-apply
+        commits, replays = [], []
+        slow = [True]
+
+        def land(i, h):
+            if i == 1 and slow[0]:
+                slow[0] = False
+                time.sleep(0.3)  # blocks past the drain deadline
+            return h
+
+        stats = execute_pipeline(
+            4, lambda i: i, land,
+            commit=lambda i, pulled: commits.append(i),
+            drain_site="t.drain",
+            replay=lambda lo, hi: replays.append((lo, hi)),
+            window=2, watchdog_default_s=0.1,
+        )
+        time.sleep(0.4)  # let the abandoned worker finish its pull
+        assert replays == [(1, 2)]
+        assert commits == [0, 3]  # item 1 committed by nobody but replay
+        assert stats.replays == 1
+
     def test_transient_without_replay_propagates(self):
         def land(i, h):
             raise TransientDeviceError("no replay path")
@@ -248,6 +318,26 @@ class TestSnapshotWriter:
         w.close()
         with pytest.raises(RuntimeError, match="closed"):
             w.submit(lambda: None)
+
+    def test_close_noflush_abandons_queued_jobs(self):
+        # flush=False is the fatal-unwind path: queued jobs must NOT
+        # run (the STOP marker may not queue FIFO behind them) and
+        # close must not block on a full queue
+        gate = threading.Event()
+        ran = []
+        w = SnapshotWriter(name="t", maxsize=2)
+        w.submit(gate.wait)  # occupies the worker
+        w.submit(lambda: ran.append(1))
+        w.submit(lambda: ran.append(2))  # queue now full
+
+        def release():
+            time.sleep(0.05)
+            gate.set()
+
+        threading.Thread(target=release).start()  # lint: thread-context-adoption-ok (test timer thread: only sets an Event, records nothing)
+        w.close(flush=False)
+        assert ran == []
+        assert w.pending == 0
 
     def test_backpressure_blocks_submit(self):
         gate = threading.Event()
