@@ -55,6 +55,7 @@ from ..sql.join import (
     pip_join_points,
     resolve_probe_mode,
 )
+from ..tune import resolve as _tune_resolve
 from .tiles import (
     TilePlan,
     assign_tile_cells,
@@ -136,17 +137,32 @@ class ZonalEngine:
         chip_index=None,
         found_cap: "int | None" = None,
         heavy_cap: "int | None" = None,
-        lookup: str = "gather",
+        lookup: "str | None" = None,
         compaction: str = "scatter",
-        probe: str = "adaptive",
+        probe: "str | None" = None,
         convex_cap: "int | None" = None,
-        lane: str = "auto",
+        lane: "str | None" = None,
         mesh=None,
+        profile=None,
     ):
         self.index_system = index_system
         self.resolution = int(resolution)
         self.chip_index = chip_index
-        self.lane = resolve_zonal_lane(lane)
+        # profile-consumed knobs fold at this host entry point: explicit
+        # arg > env knob > profile > built-in default (tune/resolve.py).
+        # lane="auto" is the legacy spelling of "not passed".
+        knobs = _tune_resolve.resolve_knobs(
+            "zonal_engine", profile,
+            explicit={
+                "probe": probe, "lookup": lookup,
+                "zonal_lane": None if lane in (None, "auto") else lane,
+            },
+            defaults={
+                "probe": "adaptive", "lookup": "gather", "zonal_lane": "fold",
+            },
+        )
+        probe, lookup = knobs["probe"], knobs["lookup"]
+        self.lane = resolve_zonal_lane(knobs["zonal_lane"])
         # placement resolves host-side once (dispatch core discipline):
         # with a mesh bound, the PIP probe runs data-parallel over the
         # pixel stream with the ChipIndex replicated — bit-identical to

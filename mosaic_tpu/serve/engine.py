@@ -34,11 +34,14 @@ bit-identical results at any device count.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from ..dispatch import BucketLadder, DispatchCore, backend_compiles
 from ..obs import trace as _trace
 from ..runtime import telemetry as _telemetry
+from ..tune.resolve import resolve_knobs
 from .admission import AdmissionController
 from .batcher import MicroBatcher
 
@@ -67,20 +70,47 @@ class ServeEngine:
         default_deadline_s: float | None = 1.0,
         bounds: tuple | None = None,
         park_point: np.ndarray | None = None,
-        writeback: str = "scatter",
+        writeback: str | None = None,
         lookup: str | None = None,
         cell_dtype=None,
         watchdog_grace_s: float = 0.5,
-        probe: str = "scatter",
+        probe: str | None = None,
         mesh=None,
+        profile=None,
     ):
         self.index = index
         self.index_system = index_system
         self.resolution = index_system.resolution_arg(resolution)
+        # profile-consumed knobs resolve HERE, at the host entry point,
+        # with the one documented precedence: explicit arg > env knob >
+        # TuningProfile > built-in default (mosaic_tpu/tune/resolve.py)
+        knobs = resolve_knobs(
+            "serve_engine", profile,
+            explicit={
+                "probe": probe, "writeback": writeback, "lookup": lookup,
+                "bucket_min": None, "bucket_max": None,
+            },
+            defaults={
+                "probe": "scatter", "writeback": "scatter", "lookup": None,
+                "bucket_min": None, "bucket_max": None,
+            },
+        )
+        probe, writeback, lookup = (
+            knobs["probe"], knobs["writeback"], knobs["lookup"]
+        )
+        if ladder is None and (knobs["bucket_min"] or knobs["bucket_max"]):
+            ladder = BucketLadder(
+                min_bucket=int(knobs["bucket_min"] or 64),
+                max_bucket=int(knobs["bucket_max"] or 65536),
+            )
         self.ladder = ladder or BucketLadder()
         self.writeback = writeback
         self.cell_dtype = cell_dtype
         self.watchdog_grace_s = float(watchdog_grace_s)
+        # a hot_swap rebinds (ladder, core, index) as one unit; the lock
+        # only guards the rebind and the dispatch-side snapshot of the
+        # pair, never the dispatch itself
+        self._swap_lock = threading.Lock()
         # the core owns probe/lookup resolution (force-lane env folds
         # once, so the compile-cache signature stays honest), caps,
         # signature accounting, and the guarded execute path
@@ -181,6 +211,81 @@ class ServeEngine:
         _telemetry.record("serve_warmup", **out)
         return out
 
+    def hot_swap(
+        self,
+        index=None,
+        *,
+        profile=None,
+        resolution: int | None = None,
+        probe: str | None = None,
+        writeback: str | None = None,
+        lookup: str | None = None,
+        ladder: BucketLadder | None = None,
+    ) -> dict:
+        """Swap in a new index and/or `TuningProfile` without dropping
+        the engine: a NEW dispatch core is built off to the side, its
+        ladder rungs precompiled and its signature set frozen
+        (`DispatchCore.warmup`), and only then is ``(ladder, core,
+        index)`` rebound as one unit — requests in flight finish on the
+        old core, requests after the swap replay cached executables.
+        Zero cold compiles after the swap is enforced by the existing
+        ``freeze()`` tripwire: any post-swap dispatch that still compiles
+        counts in ``metrics()["cold_compiles"]``.
+
+        Knob precedence matches the constructor (explicit > env > profile
+        > default), with the engine's CURRENT settings as the defaults —
+        a profile-less ``hot_swap(index)`` swaps the index and keeps the
+        tuning. Returns the new core's warmup stats."""
+        index = self.index if index is None else index
+        knobs = resolve_knobs(
+            "serve_engine.hot_swap", profile,
+            explicit={
+                "resolution": resolution,
+                "probe": probe, "writeback": writeback, "lookup": lookup,
+                "bucket_min": None, "bucket_max": None,
+            },
+            defaults={
+                "resolution": self.resolution,
+                "probe": self.core.probe, "writeback": self.writeback,
+                "lookup": self.core.lookup,
+                "bucket_min": None, "bucket_max": None,
+            },
+        )
+        new_resolution = self.index_system.resolution_arg(knobs["resolution"])
+        if ladder is None:
+            if knobs["bucket_min"] or knobs["bucket_max"]:
+                ladder = BucketLadder(
+                    min_bucket=int(knobs["bucket_min"] or 64),
+                    max_bucket=int(knobs["bucket_max"] or 65536),
+                )
+            else:
+                ladder = self.ladder
+        with _trace.span(
+            "serve.hot_swap", buckets=len(ladder.buckets),
+            profiled=profile is not None,
+        ), _telemetry.timed("serve_stage", stage="hot_swap"):
+            core = DispatchCore(
+                index, self.index_system, new_resolution, ladder=ladder,
+                writeback=knobs["writeback"], lookup=knobs["lookup"],
+                probe=knobs["probe"], cell_dtype=self.cell_dtype,
+                mesh=self.mesh, on_cold_compile=self._on_cold_compile,
+            )
+            stats = core.warmup()  # precompiles every rung, then freezes
+            with self._swap_lock:
+                self.index = index
+                self.resolution = new_resolution
+                self.ladder = ladder
+                self.core = core
+                self.writeback = knobs["writeback"]
+                self.probe = core.probe
+                self.lookup = core.lookup
+                # keep the coalescing window inside the new ladder's span
+                self.batcher.max_batch_rows = min(
+                    self.batcher.max_batch_rows, ladder.max_bucket
+                )
+        _telemetry.record("serve_swap", **stats)
+        return stats
+
     def metrics(self) -> dict:
         a, b = self.admission.metrics, self.batcher.metrics
         out = dict(a)
@@ -213,14 +318,18 @@ class ServeEngine:
     def _dispatch(self, points: np.ndarray, deadline_hint=None):
         """Batcher callback: pad, dispatch with resilience, unpad.
         Returns ``(results (n,), occupancy)``."""
-        padded, n = self.ladder.pad(points)
+        # snapshot the (ladder, core) pair so a concurrent hot_swap can
+        # never pad with one ladder and execute on the other core
+        with self._swap_lock:
+            ladder, core = self.ladder, self.core
+        padded, n = ladder.pad(points)
         bucket = padded.shape[0]
         with _trace.span(
             "serve.dispatch", bucket=bucket, rows=n,
         ), _telemetry.timed(
             "serve_stage", stage="dispatch", bucket=bucket, rows=n,
         ):
-            out = self._dispatch_resilient(padded, deadline_hint)
+            out = self._dispatch_resilient(core, padded, deadline_hint)
         occupancy = n / bucket
         return out[:n], occupancy
 
@@ -231,7 +340,7 @@ class ServeEngine:
             "serve_compile", bucket=bucket, signatures=signatures,
         )
 
-    def _dispatch_resilient(self, padded, deadline_hint) -> np.ndarray:
+    def _dispatch_resilient(self, core, padded, deadline_hint) -> np.ndarray:
         """The core's guarded execute under the batch's deadline: the
         ``serve.dispatch`` watchdog site, transient retry, and exact-f64
         host-oracle degradation — all composed by the dispatch core."""
@@ -240,7 +349,7 @@ class ServeEngine:
             if deadline_hint is None
             else max(float(deadline_hint), 0.05) + self.watchdog_grace_s
         )
-        return self.core.execute_resilient(
+        return core.execute_resilient(
             "serve.dispatch", padded, default_s=default_s
         )
 

@@ -22,7 +22,30 @@ class SampleStrategy:
     fraction: float = 1.0
     limit: "int | None" = None
 
+    def __post_init__(self):
+        # fraction=0 would floor every sample to the max(1, ...) clamp and
+        # silently analyze a single row; out-of-range fractions are always
+        # a caller bug, so fail at construction, not deep in numpy
+        if not 0.0 < self.fraction <= 1.0:
+            raise ValueError(
+                f"SampleStrategy fraction must be in (0, 1], got "
+                f"{self.fraction!r} — pass fraction=1.0 with limit=K to "
+                f"sample a fixed number of rows"
+            )
+        if self.limit is not None and self.limit < 1:
+            raise ValueError(
+                f"SampleStrategy limit must be >= 1, got {self.limit!r}"
+            )
+
     def apply(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        if n <= 0:
+            # without this, n=0 reaches rng.choice(0, size=1) and dies with
+            # an opaque "a must be greater than 0" deep in numpy
+            raise ValueError(
+                "cannot sample an empty geometry column (0 rows) — the "
+                "analyzer needs at least one geometry; check the upstream "
+                "filter or load"
+            )
         take = int(np.ceil(n * self.fraction))
         if self.limit is not None:
             take = min(take, self.limit)

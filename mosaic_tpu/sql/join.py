@@ -1617,17 +1617,18 @@ def pip_join(
     points: np.ndarray | jax.Array,
     polygons: PackedGeometry | None,
     index_system: IndexSystem,
-    resolution: int,
+    resolution: "int | None" = None,
     chip_index: ChipIndex | None = None,
     batch_size: int | None = None,
     recheck: bool | None = None,
     cell_dtype=None,
-    writeback: str = "scatter",
+    writeback: "str | None" = None,
     lookup: str | None = None,
     cell_margin_k: float | None = None,
     edge_band_k: float | None = None,
-    probe: str = "scatter",
+    probe: "str | None" = None,
     mesh=None,
+    profile=None,
 ) -> np.ndarray:
     """Managed join (reference: `PointInPolygonJoin.join` auto-indexes both
     sides, `sql/join/PointInPolygonJoin.scala:86-97`).
@@ -1691,9 +1692,41 @@ def pip_join(
     Accepts a device count, a 1-D `jax.sharding.Mesh`, or None (the
     ``MOSAIC_MESH`` env knob, resolved once per call). Requires
     ``recheck=False`` — the epsilon-band path stays single-device.
+
+    ``profile`` takes a `tune.TuningProfile`; its knobs apply with the
+    one documented precedence — explicit argument > env knob > profile >
+    built-in default (`mosaic_tpu/tune/resolve.py`). Profile-consumed
+    knobs here: ``resolution``, ``probe``, ``writeback``, ``lookup``,
+    ``batch_size`` (pass ``batch_size=0`` to explicitly force the
+    unbatched path past a profile's recommendation).
     """
+    from ..tune.resolve import resolve_knobs
+
+    # profile-consumed knobs fold HERE, at the host entry point, before
+    # anything is staged (env-read-after-staging discipline)
+    knobs = resolve_knobs(
+        "pip_join", profile,
+        explicit={
+            "resolution": resolution, "probe": probe,
+            "writeback": writeback, "lookup": lookup,
+            "batch_size": batch_size,
+        },
+        defaults={
+            "resolution": None, "probe": "scatter", "writeback": "scatter",
+            "lookup": None, "batch_size": None,
+        },
+    )
+    resolution, writeback, lookup = (
+        knobs["resolution"], knobs["writeback"], knobs["lookup"]
+    )
+    batch_size = knobs["batch_size"] or None  # 0 = explicitly unbatched
+    if resolution is None:
+        raise ValueError(
+            "pip_join needs a resolution — pass it explicitly or via a "
+            "profile that recommends one"
+        )
     resolution = index_system.resolution_arg(resolution)
-    probe = resolve_probe_mode(probe)
+    probe = resolve_probe_mode(knobs["probe"])
     if probe != "scatter" and writeback == "direct":
         raise ValueError(
             "probe='adaptive' requires writeback scatter|gather"

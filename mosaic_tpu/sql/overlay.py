@@ -22,6 +22,8 @@ from ..core.index.base import IndexSystem
 from ..core.tessellate import ChipTable, tessellate
 from ..core.types import PackedGeometry
 from ..dispatch import core as _dispatch
+from ..obs import trace as _trace
+from ..runtime import telemetry as _telemetry
 from ..runtime.errors import DegradedResult
 
 
@@ -46,29 +48,57 @@ def candidate_pairs(
 
     Returns (lrows, rrows, sure): chip-row index pairs, and ``sure`` True
     where at least one side's chip is core (intersection certain).
+
+    Emits an ``overlay.candidates`` span (and matching
+    ``overlay_candidates`` telemetry) with the candidate count, the
+    sure-fraction (pairs accepted without a predicate), and the
+    border-pair fraction (pairs that will pay the exact predicate) — the
+    statistics that make overlay workloads profileable like the point
+    frontends.
     """
-    lc = np.asarray(left.cell_id)
-    rc = np.asarray(right.cell_id)
-    lo = np.argsort(lc, kind="stable")
-    ro = np.argsort(rc, kind="stable")
-    lu, ls, le_ = _group_spans(lc[lo])
-    ru, rs, re_ = _group_spans(rc[ro])
-    common, li, ri = np.intersect1d(lu, ru, return_indices=True)
-    if not common.shape[0]:
-        z = np.zeros(0, np.int64)
-        return z, z, np.zeros(0, bool)
-    # vectorized per-cell cross join: left rows repeat by the right group
-    # size, right rows tile within each (cell, left-row) block
-    ln = le_[li] - ls[li]  # left group size per common cell
-    rn = re_[ri] - rs[ri]  # right group size per common cell
-    pair_n = ln * rn
-    cell_of = np.repeat(np.arange(common.shape[0]), pair_n)
-    off = np.concatenate([[0], np.cumsum(pair_n)])[:-1]
-    k = np.arange(int(pair_n.sum())) - off[cell_of]  # rank within cell
-    lrows = lo[ls[li][cell_of] + k // rn[cell_of]]
-    rrows = ro[rs[ri][cell_of] + k % rn[cell_of]]
-    sure = np.asarray(left.is_core)[lrows] | np.asarray(right.is_core)[rrows]
-    return lrows, rrows, sure
+    with _trace.span(
+        "overlay.candidates",
+        left_chips=int(np.asarray(left.cell_id).shape[0]),
+        right_chips=int(np.asarray(right.cell_id).shape[0]),
+    ) as span:
+        lc = np.asarray(left.cell_id)
+        rc = np.asarray(right.cell_id)
+        lo = np.argsort(lc, kind="stable")
+        ro = np.argsort(rc, kind="stable")
+        lu, ls, le_ = _group_spans(lc[lo])
+        ru, rs, re_ = _group_spans(rc[ro])
+        common, li, ri = np.intersect1d(lu, ru, return_indices=True)
+        if not common.shape[0]:
+            z = np.zeros(0, np.int64)
+            span.set(candidates=0, sure_fraction=0.0, border_fraction=0.0)
+            _telemetry.record(
+                "overlay_candidates", candidates=0,
+                sure_fraction=0.0, border_fraction=0.0,
+            )
+            return z, z, np.zeros(0, bool)
+        # vectorized per-cell cross join: left rows repeat by the right
+        # group size, right rows tile within each (cell, left-row) block
+        ln = le_[li] - ls[li]  # left group size per common cell
+        rn = re_[ri] - rs[ri]  # right group size per common cell
+        pair_n = ln * rn
+        cell_of = np.repeat(np.arange(common.shape[0]), pair_n)
+        off = np.concatenate([[0], np.cumsum(pair_n)])[:-1]
+        k = np.arange(int(pair_n.sum())) - off[cell_of]  # rank within cell
+        lrows = lo[ls[li][cell_of] + k // rn[cell_of]]
+        rrows = ro[rs[ri][cell_of] + k % rn[cell_of]]
+        sure = (
+            np.asarray(left.is_core)[lrows] | np.asarray(right.is_core)[rrows]
+        )
+        n = int(sure.shape[0])
+        sure_fraction = float(sure.sum()) / max(1, n)
+        stats = {
+            "candidates": n,
+            "sure_fraction": round(sure_fraction, 6),
+            "border_fraction": round(1.0 - sure_fraction, 6),
+        }
+        span.set(**stats)
+        _telemetry.record("overlay_candidates", **stats)
+        return lrows, rrows, sure
 
 
 def intersects_join(

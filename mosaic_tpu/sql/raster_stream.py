@@ -37,6 +37,7 @@ from ..runtime import (
     telemetry as _telemetry,
 )
 from ..runtime.errors import RetryExhausted
+from ..tune import resolve as _tune_resolve
 
 __all__ = ["RasterScanResult", "RasterStream"]
 
@@ -81,12 +82,23 @@ class RasterStream:
         *,
         found_cap: "int | None" = None,
         heavy_cap: "int | None" = None,
-        lookup: str = "gather",
+        lookup: "str | None" = None,
         compaction: str = "scatter",
-        probe: str = "adaptive",
+        probe: "str | None" = None,
         convex_cap: "int | None" = None,
         mesh=None,
+        profile=None,
     ):
+        # profile-consumed knobs fold at this host entry point: explicit
+        # arg > env knob > profile > built-in default (tune/resolve.py);
+        # the tile/window knobs resolve per scan, where they apply
+        self._profile = profile
+        knobs = _tune_resolve.resolve_knobs(
+            "raster_stream", profile,
+            explicit={"probe": probe, "lookup": lookup},
+            defaults={"probe": "adaptive", "lookup": "gather"},
+        )
+        probe, lookup = knobs["probe"], knobs["lookup"]
         # the stream always folds on the f64-capable jnp lane — the
         # durable contract is bit-identity through kill/resume, and the
         # f32 Pallas lane only holds it on exact-summable data
@@ -208,6 +220,15 @@ class RasterStream:
         retry_policy, trace_parent, window=None,
     ) -> RasterScanResult:
         tiles, _zn = _zonal()
+        # per-scan knobs: an explicit tile (or a resume's snapshot tile)
+        # wins, then MOSAIC_RASTER_TILE / MOSAIC_STREAM_WINDOW, then the
+        # constructor's TuningProfile, then the built-in defaults
+        knobs = _tune_resolve.resolve_knobs(
+            "raster_stream.scan", self._profile,
+            explicit={"raster_tile": tile, "stream_window": window},
+            defaults={"raster_tile": None, "stream_window": None},
+        )
+        tile, window = knobs["raster_tile"], knobs["stream_window"]
         plan = tiles.plan_tiles(raster, tile)
         th, tw = plan.shape
         g = self.num_zones

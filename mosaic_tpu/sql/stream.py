@@ -77,6 +77,7 @@ import numpy as np
 
 from ..dispatch import core as _dispatch, pipeline as _pipeline
 from ..obs import metrics as _metrics, trace as _trace
+from ..tune import resolve as _tune_resolve
 from ..runtime import (
     checkpoint as _checkpoint,
     faults as _faults,
@@ -436,16 +437,28 @@ class StreamJoin:
         compaction: str | None = None,
         cell_dtype=jnp.float32,
         prefetch: bool = True,
-        probe: str = "scatter",
+        probe: "str | None" = None,
         convex_cap: int | None = None,
         donate_ring: bool = False,
         mesh=None,
+        profile=None,
     ):
         self.index = index
         self.index_system = index_system
         self.resolution = resolution
         self.prefetch = bool(prefetch)
         self.donate_ring = bool(donate_ring)
+        #: the TuningProfile consulted again at run_durable/resume time
+        #: for the pipeline/window knobs (same precedence as here)
+        self._profile = profile
+        # profile-consumed knobs fold at this host entry point: explicit
+        # arg > env knob > profile > built-in default (tune/resolve.py)
+        knobs = _tune_resolve.resolve_knobs(
+            "stream_join", profile,
+            explicit={"probe": probe, "lookup": lookup},
+            defaults={"probe": "scatter", "lookup": None},
+        )
+        probe, lookup = knobs["probe"], knobs["lookup"]
         #: (ring fingerprint, report) of the last admission, if any
         self._last_quarantine: tuple | None = None
         dtype = index.border.verts.dtype
@@ -898,11 +911,14 @@ class StreamJoin:
         k, batch = int(ring.shape[0]), int(ring.shape[1])
         self._check_batch(batch)
         snapshot_every = max(1, snapshot_every)
-        if pipeline is None:
-            # mode knob resolved at call time, never inside traced code
-            pipeline = os.environ.get(
-                "MOSAIC_STREAM_PIPELINE", ""
-            ) not in ("", "0")
+        # mode knobs resolved at call time, never inside traced code:
+        # explicit arg > MOSAIC_STREAM_PIPELINE/_WINDOW > profile > default
+        knobs = _tune_resolve.resolve_knobs(
+            "stream_join.run_durable", self._profile,
+            explicit={"stream_pipeline": pipeline, "stream_window": window},
+            defaults={"stream_pipeline": False, "stream_window": None},
+        )
+        pipeline, window = knobs["stream_pipeline"], knobs["stream_window"]
         ring_np = np.asarray(ring)  # host twin: fingerprint + fallback
         ring_fp = _checkpoint.fingerprint(ring_np)
         # one root span per durable run; a resume parents to the

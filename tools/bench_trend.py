@@ -40,6 +40,7 @@ PATTERNS = (
     "MULTICHIP_r*.json",
     "RASTER_r*.json",
     "STALL_r*.json",
+    "TUNE_r*.json",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)$")
