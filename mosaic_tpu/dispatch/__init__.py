@@ -44,6 +44,14 @@ from .pipeline import (
     execute_pipeline,
     resolve_window,
 )
+from .programs import (
+    ProgramFingerprintMismatch,
+    ProgramStore,
+    ProgramStoreCorrupt,
+    backend_fingerprint,
+    program_key,
+    resolve_program_store,
+)
 
 __all__ = [
     "BucketLadder",
@@ -51,8 +59,12 @@ __all__ = [
     "DEFAULT_MIN_BUCKET",
     "DispatchCore",
     "PipelineStats",
+    "ProgramFingerprintMismatch",
+    "ProgramStore",
+    "ProgramStoreCorrupt",
     "SnapshotWriter",
     "backend_compiles",
+    "backend_fingerprint",
     "bounded_cache",
     "cache_stats",
     "cache_view",
@@ -69,8 +81,10 @@ __all__ = [
     "join_cache_view",
     "mesh_key",
     "probe_check_rep",
+    "program_key",
     "register_cache",
     "resolve_mesh",
+    "resolve_program_store",
     "resolve_window",
     "sharded_join_prog",
     "sharded_pointwise",

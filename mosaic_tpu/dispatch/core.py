@@ -45,7 +45,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..obs import metrics as _metrics
 from ..obs import trace as _trace
+from ..runtime import checkpoint as _checkpoint
 from ..runtime import telemetry as _telemetry, watchdog as _watchdog
 from ..runtime.retry import call_with_retry
 from .bucket import (
@@ -53,6 +55,15 @@ from .bucket import (
     backend_compiles,
     dispatch_signature,
     mesh_key,
+)
+from .programs import (
+    ProgramFingerprintMismatch,
+    ProgramStoreCorrupt,
+    core_program_statics,
+    deserialize_compiled,
+    program_key,
+    resolve_program_store,
+    serialize_compiled,
 )
 
 __all__ = [
@@ -155,12 +166,18 @@ def bounded_cache(name: str, maxsize: int):
 
 def _stats_of(cached_fn) -> dict:
     i = cached_fn.cache_info()
-    return {
+    out = {
         "hits": i.hits,
         "misses": i.misses,
         "maxsize": i.maxsize,
         "currsize": i.currsize,
     }
+    # caches that track more than the lru_cache protocol (evictions,
+    # occupancy — `_CoreCache`) surface it through the same view
+    extra = getattr(cached_fn, "extra_stats", None)
+    if callable(extra):
+        out.update(extra())
+    return out
 
 
 def _jit_cache_size(fn) -> int:
@@ -472,6 +489,7 @@ class DispatchCore:
         cell_dtype=None,
         mesh=None,
         on_cold_compile=None,
+        program_store=None,
     ):
         self.index = index
         self.index_system = index_system
@@ -513,6 +531,19 @@ class DispatchCore:
         self._warmed: frozenset | None = None
         self._cold_compiles = 0
         self._on_cold_compile = on_cold_compile
+        # AOT program persistence (dispatch/programs.py): explicit arg
+        # beats the MOSAIC_PROGRAM_STORE env knob. Sharded executables
+        # bind to a concrete mesh topology the store does not model, so
+        # a meshed core refuses the store (recorded, never silent).
+        self._programs = resolve_program_store(program_store)
+        if self._programs is not None and self.mesh is not None:
+            _telemetry.record(
+                "program_store_refused", reason="mesh",
+                devices=self.mesh.size,
+            )
+            self._programs = None
+        self._aot: dict = {}  # bucket -> (cells_fn, join_fn) | None
+        self.aot_stats = {"loaded": 0, "exported": 0, "fallback": 0}
 
     # ------------------------------------------------------- accounting
 
@@ -556,6 +587,103 @@ class DispatchCore:
         contract's tripwire)."""
         self._warmed = frozenset(self._signatures)
 
+    # ------------------------------------------------------ AOT programs
+
+    def _index_fingerprint(self) -> str:
+        """Restart-stable tessellation identity for program-store keys
+        (the in-process `dispatch_signature` keys on ``id(index)``,
+        which a restart recycles)."""
+        if getattr(self, "_index_fp", None) is None:
+            self._index_fp = _checkpoint.fingerprint(
+                np.asarray(self.index.cells)
+            )
+        return self._index_fp
+
+    def _aot_bundle(self, bucket: int):
+        """The bucket's ``(cells_fn, join_fn)`` AOT pair: loaded from
+        the program store when a valid entry exists, otherwise compiled
+        and exported. Any refusal (corrupt entry, fingerprint mismatch,
+        unserializable program) falls back to the plain jit path for
+        this bucket — never a wrong program, never a crash."""
+        if bucket in self._aot:
+            return self._aot[bucket]
+        with _trace.span("dispatch.aot", bucket=bucket):
+            try:
+                bundle = self._load_or_export(bucket)
+            except Exception as e:  # lint: broad-except-ok (AOT is an optimization: ANY failure in serialization internals must degrade to plain compilation, not take down the dispatch)
+                _telemetry.record(
+                    "program_store_fallback", bucket=bucket,
+                    error=repr(e)[:200],
+                )
+                self.aot_stats["fallback"] += 1
+                bundle = None
+        self._aot[bucket] = bundle
+        return bundle
+
+    def _load_or_export(self, bucket: int):
+        import jax as _jax
+
+        fp = self._index_fingerprint()
+        fcap, hcap, ccap = self.caps(bucket)
+        # prototypes mirror execute_padded exactly: jnp.asarray folds the
+        # x64 config into the cells input dtype; shifted uses the index
+        # vertex dtype
+        in_dtype = (
+            np.dtype(self.cell_dtype)
+            if self.cell_dtype is not None
+            else _jax.dtypes.canonicalize_dtype(np.float64)
+        )
+        pts_proto = _jax.ShapeDtypeStruct((bucket, 2), in_dtype)
+        cfn = cells_prog(self.index_system, self.resolution, "cells")
+        cells_aval = _jax.eval_shape(cfn, pts_proto)
+
+        cells_fn = self._one_program(
+            program_key(fp, "cells", **core_program_statics(
+                self, bucket, "cells")),
+            lambda: cfn.lower(pts_proto).compile(),
+            (pts_proto,), cells_aval,
+            meta={"kind": "cells", "bucket": bucket},
+        )
+
+        shifted_proto = _jax.ShapeDtypeStruct((bucket, 2), self._dtype)
+        jj = jit_join()
+        statics = dict(
+            heavy_cap=hcap, found_cap=fcap, writeback=self.writeback,
+            lookup=self.lookup, probe=self.probe, convex_cap=ccap,
+        )
+        out_aval = _jax.eval_shape(
+            lambda a, b, c: jj(a, b, c, **statics),
+            shifted_proto, cells_aval, self.index,
+        )
+        join_fn = self._one_program(
+            program_key(fp, "join", **core_program_statics(
+                self, bucket, "join")),
+            lambda: jj.lower(
+                shifted_proto, cells_aval, self.index, **statics
+            ).compile(),
+            (shifted_proto, cells_aval, self.index), out_aval,
+            meta={"kind": "join", "bucket": bucket},
+        )
+        return cells_fn, join_fn
+
+    def _one_program(self, key, compile_fn, example_args, out_aval, meta):
+        """Load one program from the store or compile + export it.
+        Typed store refusals (corrupt, fingerprint mismatch) degrade to
+        the compile path and re-export — the store self-heals."""
+        payload = None
+        try:
+            payload = self._programs.load(key)
+        except (ProgramStoreCorrupt, ProgramFingerprintMismatch):
+            pass  # typed telemetry already recorded by the store
+        if payload is not None:
+            fn = deserialize_compiled(payload, example_args, out_aval)
+            self.aot_stats["loaded"] += 1
+            return fn
+        compiled = compile_fn()
+        self._programs.save(key, serialize_compiled(compiled), meta=meta)
+        self.aot_stats["exported"] += 1
+        return compiled
+
     # ---------------------------------------------------------- execute
 
     def execute_padded(self, padded: np.ndarray) -> np.ndarray:
@@ -595,6 +723,7 @@ class DispatchCore:
                 "dispatch.compile", bucket=bucket,
                 signatures=len(self._signatures),
             )
+        bundle = self._aot_bundle(bucket) if self._programs is not None else None
         try:
             with _trace.span(
                 "dispatch.transfer.h2d", nbytes=int(padded.nbytes),
@@ -608,18 +737,29 @@ class DispatchCore:
             # batch-path heuristic of going eager below 64k rows on CPU
             # trades a one-off compile for a ~1000x slower dispatch —
             # the right trade for a single cold batch, the wrong one on
-            # a hot path
-            cells = cells_prog(
-                self.index_system, self.resolution, "cells"
-            )(dev)
+            # a hot path. With a program store bound, the bucket's
+            # AOT-loaded executables replace both programs outright.
+            if bundle is not None:
+                cells = bundle[0](dev)
+            else:
+                cells = cells_prog(
+                    self.index_system, self.resolution, "cells"
+                )(dev)
             with _trace.span(
                 "dispatch.transfer.h2d", nbytes=int(padded.nbytes),
                 bucket=bucket, shifted=True,
             ):
+                # cast host-side (IEEE round-to-nearest, bit-identical
+                # to XLA's convert) so the transfer is a plain device
+                # put — jnp.asarray with a dtype change would compile a
+                # tiny convert program per bucket shape, which a
+                # store-warmed restart counts as a cold compile
                 shifted = jnp.asarray(
-                    padded - self._shift, dtype=self._dtype
+                    np.asarray(padded - self._shift, dtype=self._dtype)
                 )
-            if self.mesh is None:
+            if bundle is not None:
+                out = bundle[1](shifted, cells, self.index)
+            elif self.mesh is None:
                 out = jit_join()(
                     shifted, cells, self.index,
                     heavy_cap=hcap, found_cap=fcap,
@@ -703,6 +843,8 @@ class DispatchCore:
         }
         if t0 is not None and t1 is not None:
             out["backend_compiles"] = t1 - t0
+        if self._programs is not None:
+            out["aot"] = dict(self.aot_stats)
         _telemetry.record("dispatch_warmup", **out)
         return out
 
@@ -710,28 +852,65 @@ class DispatchCore:
 # -------------------------------------------- batch-path core memoization
 
 class _CoreCache:
-    """A tiny bounded insertion-order cache for batch-path
+    """A bounded occupancy-aware LRU cache for resident
     :class:`DispatchCore` instances, speaking the `lru_cache`
     `cache_info()`/`cache_clear()` protocol so it registers in
-    :func:`cache_stats` like every other dispatch cache."""
+    :func:`cache_stats` like every other dispatch cache.
+
+    Eviction picks the least-recently-used entry, with COLD cores
+    (never warmed — no precompiled ladder, so nothing of value to
+    drop) evicted before warmed ones regardless of recency: a tenant
+    whose core was warmed at real compile cost outlives a tenant that
+    never finished warming. Evictions and occupancy land in the
+    ``extra_stats`` view (`cache_stats`/`cache_view` merge it) and on
+    the obs metrics spine (``dispatch.core_cache_evictions`` counter,
+    ``dispatch.core_cache_occupancy`` gauge)."""
 
     def __init__(self, maxsize: int):
         self.maxsize = maxsize
         self._d: dict = {}
         self._hits = 0
         self._misses = 0
+        self._evictions = 0
 
     def get(self, key):
         core = self._d.get(key)
         if core is not None:
             self._hits += 1
+            # LRU recency: a hit moves the entry to the back
+            self._d[key] = self._d.pop(key)
         return core
+
+    def _evict_one(self) -> None:
+        victim = next(
+            (k for k, c in self._d.items() if not getattr(c, "warmed", False)),
+            next(iter(self._d)),
+        )
+        self._d.pop(victim)
+        self._evictions += 1
+        _metrics.counter(
+            "dispatch.core_cache_evictions",
+            "resident DispatchCores dropped by the occupancy-aware LRU",
+        ).inc()
 
     def put(self, key, core):
         self._misses += 1
         while len(self._d) >= self.maxsize:
-            self._d.pop(next(iter(self._d)))
+            self._evict_one()
         self._d[key] = core
+        _metrics.gauge(
+            "dispatch.core_cache_occupancy",
+            "resident DispatchCore slots in use / maxsize",
+        ).set(len(self._d) / max(self.maxsize, 1))
+
+    def occupancy(self) -> float:
+        return len(self._d) / max(self.maxsize, 1)
+
+    def extra_stats(self) -> dict:
+        return {
+            "evictions": self._evictions,
+            "occupancy": round(self.occupancy(), 4),
+        }
 
     def cache_info(self):
         return functools._CacheInfo(
@@ -742,6 +921,7 @@ class _CoreCache:
         self._d.clear()
         self._hits = 0
         self._misses = 0
+        self._evictions = 0
 
 
 _BATCH_CORES = _CoreCache(maxsize=8)

@@ -18,13 +18,19 @@ Component map: `bucket.py` (pad-to-bucket ladder + compile accounting),
 `admission.py` (bounded queue, deadlines, poison parking, typed
 ``Overloaded``), `batcher.py` (max-batch/max-wait coalescing with
 per-request deadline shedding), `engine.py` (lifecycle + resilience
-wiring). Bench: `tools/serve_bench.py`.
+wiring), `router.py` (multi-tenant front door: per-tenant engines with
+hard isolation, bounded residency with occupancy-aware LRU eviction,
+per-tenant tuning profiles and shed accounting). Benches:
+`tools/serve_bench.py` (single- and multi-tenant),
+`tools/restart_bench.py` (zero-cold-start restart storm over the AOT
+program store).
 """
 
 from .admission import AdmissionController, Request
 from .batcher import MicroBatcher
 from .bucket import BucketLadder, backend_compiles, dispatch_signature
 from .engine import ServeEngine
+from .router import ServeRouter, resolve_max_resident
 
 __all__ = [
     "AdmissionController",
@@ -32,6 +38,8 @@ __all__ = [
     "MicroBatcher",
     "Request",
     "ServeEngine",
+    "ServeRouter",
     "backend_compiles",
     "dispatch_signature",
+    "resolve_max_resident",
 ]

@@ -38,7 +38,12 @@ import threading
 
 import numpy as np
 
-from ..dispatch import BucketLadder, DispatchCore, backend_compiles
+from ..dispatch import (
+    BucketLadder,
+    DispatchCore,
+    backend_compiles,
+    resolve_program_store,
+)
 from ..obs import trace as _trace
 from ..runtime import telemetry as _telemetry
 from ..tune.resolve import resolve_knobs
@@ -77,6 +82,7 @@ class ServeEngine:
         probe: str | None = None,
         mesh=None,
         profile=None,
+        program_store=None,
     ):
         self.index = index
         self.index_system = index_system
@@ -113,12 +119,17 @@ class ServeEngine:
         self._swap_lock = threading.Lock()
         # the core owns probe/lookup resolution (force-lane env folds
         # once, so the compile-cache signature stays honest), caps,
-        # signature accounting, and the guarded execute path
+        # signature accounting, the guarded execute path, and (when a
+        # store is bound — explicit arg or MOSAIC_PROGRAM_STORE) the
+        # AOT program persistence that makes warmup a load, not a
+        # compile storm
+        self.program_store = resolve_program_store(program_store)
         self.core = DispatchCore(
             index, index_system, resolution, ladder=self.ladder,
             writeback=writeback, lookup=lookup, probe=probe,
             cell_dtype=cell_dtype, mesh=mesh,
             on_cold_compile=self._on_cold_compile,
+            program_store=self.program_store,
         )
         self.probe = self.core.probe
         self.lookup = self.core.lookup
@@ -208,6 +219,8 @@ class ServeEngine:
         }
         if t0 is not None and t1 is not None:
             out["backend_compiles"] = t1 - t0
+        if self.program_store is not None:
+            out["aot"] = dict(self.core.aot_stats)
         _telemetry.record("serve_warmup", **out)
         return out
 
@@ -269,6 +282,7 @@ class ServeEngine:
                 writeback=knobs["writeback"], lookup=knobs["lookup"],
                 probe=knobs["probe"], cell_dtype=self.cell_dtype,
                 mesh=self.mesh, on_cold_compile=self._on_cold_compile,
+                program_store=self.program_store,
             )
             stats = core.warmup()  # precompiles every rung, then freezes
             with self._swap_lock:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 from .profiler import (
     WorkloadProfile,
+    profile_overlay,
     profile_points,
     profile_polygons,
     profile_raster,
@@ -38,6 +39,7 @@ __all__ = [
     "WorkloadProfile",
     "index_fingerprint",
     "load_priors",
+    "profile_overlay",
     "profile_points",
     "profile_polygons",
     "profile_raster",

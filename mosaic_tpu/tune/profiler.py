@@ -58,6 +58,8 @@ class WorkloadProfile:
     optimal_resolution: "int | None" = None
     tile_occupancy: "float | None" = None
     nodata_fraction: "float | None" = None
+    sure_fraction: "float | None" = None  # overlay pairs decided core-free
+    border_fraction: "float | None" = None  # overlay pairs paying the predicate
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -191,6 +193,59 @@ def profile_polygons(
             cells_per_geom={
                 k.rsplit("_", 1)[0]: float(v) for k, v in at.items()
             } or None,
+        )
+        _telemetry.record("tune_profile", **_flat(prof))
+        return prof
+
+
+def profile_overlay(
+    left,
+    right,
+    index_system,
+    resolution: int,
+    *,
+    left_chips=None,
+    right_chips=None,
+) -> WorkloadProfile:
+    """Profile a polygon-polygon overlay join by CONSUMING the statistics
+    `sql.overlay.candidate_pairs` already emits on its
+    ``overlay.candidates`` span: the candidate count, the sure-fraction
+    (pairs a core chip decides predicate-free), and the border-fraction
+    (pairs that pay the exact ``st_intersects`` predicate). Border-heavy
+    overlays are predicate-bound, and the recommender turns that into a
+    finer-tessellation recommendation (`recommend.OVERLAY_BORDER_SHARE`).
+
+    Pass prebuilt chip tables to amortize tessellation, exactly as
+    `intersects_join` does."""
+    from ..core.tessellate import tessellate
+    from ..sql.overlay import candidate_pairs
+
+    with _trace.span(
+        "tune.profile", kind="overlay", resolution=int(resolution)
+    ), _telemetry.timed("tune_stage", stage="profile", kind="overlay"):
+        lt = (
+            left_chips
+            if left_chips is not None
+            else tessellate(left, index_system, resolution)
+        )
+        rt = (
+            right_chips
+            if right_chips is not None
+            else tessellate(right, index_system, resolution)
+        )
+        with _telemetry.capture() as events:
+            candidate_pairs(lt, rt)
+        stats = next(
+            e for e in reversed(events)
+            if e.get("event") == "overlay_candidates"
+        )
+        prof = WorkloadProfile(
+            kind="overlay",
+            n_sampled=int(stats["candidates"]),
+            n_total=int(stats["candidates"]),
+            resolution=int(resolution),
+            sure_fraction=float(stats["sure_fraction"]),
+            border_fraction=float(stats["border_fraction"]),
         )
         _telemetry.record("tune_profile", **_flat(prof))
         return prof

@@ -30,6 +30,14 @@ ADAPTIVE_DENSE_SHARE = 0.25
 #: round 6 (RASTER_r06): sparse coverage wastes pad compute in big tiles
 SPARSE_TILE_OCCUPANCY = 0.5
 
+#: border-pair share above which an overlay join is predicate-bound and
+#: one step finer tessellation pays: smaller cells convert border chips
+#: to core chips, and core pairs are decided WITHOUT the exact
+#: ``st_intersects`` predicate (`sql/overlay.py` accepts them outright),
+#: so past an even split the predicate batch shrinks faster than the
+#: candidate list grows
+OVERLAY_BORDER_SHARE = 0.5
+
 
 @dataclasses.dataclass
 class TuningProfile:
@@ -130,6 +138,23 @@ def _recommend(profile: WorkloadProfile, priors: dict) -> TuningProfile:
             "analyzer-target-cells",
             {"cells_per_geom": profile.cells_per_geom,
              "optimal_resolution": profile.optimal_resolution},
+        )
+
+    if (
+        profile.kind == "overlay"
+        and profile.border_fraction is not None
+        and profile.resolution is not None
+        and profile.border_fraction > OVERLAY_BORDER_SHARE
+    ):
+        # consumed from the overlay.candidates span stats the profiler
+        # captures (sql/overlay.py emits them on every candidate pass)
+        set_knob(
+            "resolution", int(profile.resolution) + 1,
+            "border-dominated-finer-tessellation",
+            {"border_fraction": profile.border_fraction,
+             "sure_fraction": profile.sure_fraction,
+             "candidates": profile.n_sampled,
+             "threshold": OVERLAY_BORDER_SHARE},
         )
 
     shares = profile.class_shares or {}

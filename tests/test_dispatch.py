@@ -301,11 +301,16 @@ class TestCacheRegistry:
         )
         for name in (
             "jit_join", "cells_prog", "stream_programs", "sharded_join",
-            "batch_cores", "dist_join_step", "knn_sharded_distance",
+            "dist_join_step", "knn_sharded_distance",
         ):
             assert set(stats[name]) == {
                 "hits", "misses", "maxsize", "currsize"
             }, name
+        # batch_cores carries eviction-policy extras on top of the base
+        assert set(stats["batch_cores"]) == {
+            "hits", "misses", "maxsize", "currsize",
+            "evictions", "occupancy",
+        }
         assert set(stats["jit_programs"]) == {"join", "counts", "compact"}
 
     def test_clear_caches_is_selective_and_emits(self, index):

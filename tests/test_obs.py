@@ -640,7 +640,7 @@ class TestPerfGate:
                 "serve_stage", "stream_stage", "serve_request",
                 "recheck_narrow", "quarantine_stage", "snapshot_saved",
                 "probe_stage", "raster_stage", "multichip_stage",
-                "expr_stage", "tune_stage",
+                "expr_stage", "tune_stage", "router_stage",
             ), key
 
 

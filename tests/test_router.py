@@ -1,0 +1,412 @@
+"""Multi-tenant router contract (PR 16): per-tenant engines with hard
+admission isolation (one tenant's overload cannot occupy another's
+quota), bounded residency with LRU evict + transparent AOT-backed
+revival, fault sites on the shared machinery, swap-under-load
+bit-identity, and the `_CoreCache` stats surface —
+`mosaic_tpu/serve/router.py` + `mosaic_tpu/dispatch/core.py`."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mosaic_tpu.core.geometry import wkt
+from mosaic_tpu.core.index import CustomIndexSystem, GridConf
+from mosaic_tpu.core.tessellate import tessellate
+from mosaic_tpu.dispatch import BucketLadder, cache_stats, cache_view
+from mosaic_tpu.dispatch.core import _CoreCache
+from mosaic_tpu.runtime import faults
+from mosaic_tpu.runtime.errors import Overloaded, TransientDeviceError
+from mosaic_tpu.serve import ServeRouter, resolve_max_resident
+from mosaic_tpu.sql.join import build_chip_index, pip_join
+
+BBOX = (-25.0, -25.0, 35.0, 20.0)
+RES = 3
+
+
+@pytest.fixture(scope="module")
+def grid():
+    return CustomIndexSystem(GridConf(-180, 180, -90, 90, 2, 10.0, 10.0))
+
+
+def _index(grid, wkts):
+    col = wkt.from_wkt(wkts)
+    return build_chip_index(tessellate(col, grid, RES, keep_core_geoms=False))
+
+
+@pytest.fixture(scope="module")
+def index_a(grid):
+    return _index(grid, [
+        "POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1))",
+        "POLYGON ((-20 -20, -5 -20, -5 -5, -20 -5, -20 -20))",
+        "POLYGON ((20 -10, 30 -10, 30 5, 20 5, 20 -10))",
+    ])
+
+
+@pytest.fixture(scope="module")
+def index_b(grid):
+    # deliberately DIFFERENT coverage so swapped answers are
+    # distinguishable from index_a's
+    return _index(grid, [
+        "POLYGON ((-24 -24, 34 -24, 34 19, -24 19, -24 -24))",
+    ])
+
+
+@pytest.fixture(scope="module")
+def store(tmp_path_factory):
+    """One AOT program store shared by every router in this module:
+    after the first tenant exports, every revival is a pure load."""
+    return str(tmp_path_factory.mktemp("programs"))
+
+
+def make_router(grid, store, **kw):
+    kw.setdefault("program_store", store)
+    kw.setdefault("engine_defaults", {
+        "ladder": BucketLadder(64, 256),
+        "bounds": BBOX,
+        "max_wait_s": 0.01,
+    })
+    return ServeRouter(grid, **kw)
+
+
+def rand_points(rng, n):
+    return rng.uniform(BBOX[:2], BBOX[2:], (n, 2))
+
+
+def ref_join(pts, grid, index):
+    return np.asarray(
+        pip_join(pts, None, grid, RES, chip_index=index, recheck=False)
+    )
+
+
+def settle(futures):
+    """Drain a list of futures, swallowing sheds (the flood tests only
+    care that they resolved, not how)."""
+    for f in futures:
+        try:
+            f.result(timeout=10)
+        except Overloaded:
+            pass
+
+
+class TestRouterBasics:
+    def test_unknown_tenant_is_keyerror(self, grid, store):
+        with make_router(grid, store) as router:
+            with pytest.raises(KeyError, match="unknown tenant"):
+                router.submit("ghost", np.zeros((4, 2)))
+            with pytest.raises(KeyError):
+                router.evict("ghost")
+            with pytest.raises(KeyError):
+                router.swap("ghost")
+
+    def test_duplicate_tenant_rejected(self, grid, store, index_a):
+        with make_router(grid, store) as router:
+            router.add_tenant("acme", index_a, RES, warm=False)
+            with pytest.raises(ValueError, match="already registered"):
+                router.add_tenant("acme", index_a, RES, warm=False)
+
+    def test_resolve_max_resident_precedence(self, monkeypatch):
+        monkeypatch.delenv("MOSAIC_SERVE_TENANTS", raising=False)
+        assert resolve_max_resident(None) == 4
+        assert resolve_max_resident(2) == 2
+        monkeypatch.setenv("MOSAIC_SERVE_TENANTS", "7")
+        assert resolve_max_resident(None) == 7
+        assert resolve_max_resident(1) == 1  # explicit beats env
+        with pytest.raises(ValueError, match=">= 1"):
+            resolve_max_resident(0)
+
+    def test_closed_router_refuses(self, grid, store, index_a):
+        router = make_router(grid, store)
+        router.add_tenant("acme", index_a, RES, warm=False)
+        router.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            router.submit("acme", np.zeros((4, 2)))
+
+
+class TestResidencyAndRevival:
+    def test_lru_evict_and_transparent_revive(
+        self, grid, store, index_a
+    ):
+        """max_resident=1: registering B evicts A; submitting to A
+        revives it (evicting B) and answers bit-identically — eviction
+        is invisible to correctness."""
+        rng = np.random.default_rng(5)
+        pts = rand_points(rng, 100)
+        ref = ref_join(pts, grid, index_a)
+        with make_router(grid, store, max_resident=1) as router:
+            router.add_tenant("a", index_a, RES)
+            router.add_tenant("b", index_a, RES)
+            m = router.metrics()
+            assert m["resident"] == 1 and m["evictions"] == 1
+            assert not m["tenants"]["a"]["resident"]
+            assert m["tenants"]["b"]["resident"]
+
+            np.testing.assert_array_equal(router.join("a", pts), ref)
+            m = router.metrics()
+            assert m["tenants"]["a"]["resident"]
+            assert not m["tenants"]["b"]["resident"]
+            assert m["tenants"]["a"]["revivals"] == 2
+            assert m["evictions"] == 2
+
+    def test_revival_warms_from_store_not_compiler(
+        self, grid, store, index_a
+    ):
+        """With the program store bound, a revival's warmup is an AOT
+        load: zero exports, zero backend compiles (the reason bounded
+        residency is cheap enough to be viable)."""
+        with make_router(grid, store, max_resident=1) as router:
+            router.add_tenant("a", index_a, RES)  # exports on first ever run
+            stats = router.add_tenant("b", index_a, RES)  # same tessellation
+            assert stats["aot"]["exported"] == 0
+            assert stats["aot"]["loaded"] > 0
+            assert stats.get("backend_compiles") in (0, None)
+
+    def test_explicit_evict_keeps_registration(
+        self, grid, store, index_a
+    ):
+        rng = np.random.default_rng(6)
+        pts = rand_points(rng, 64)
+        with make_router(grid, store) as router:
+            router.add_tenant("a", index_a, RES)
+            router.evict("a")
+            assert not router.metrics()["tenants"]["a"]["resident"]
+            # last-known metrics survive eviction
+            assert "shed" in router.metrics()["tenants"]["a"]
+            np.testing.assert_array_equal(
+                router.join("a", pts), ref_join(pts, grid, index_a)
+            )
+
+
+class TestIsolation:
+    def test_aggressor_flood_cannot_touch_victim(
+        self, grid, store, index_a
+    ):
+        """The acceptance pin: tenant A at a many-times-over flood of
+        its own tiny quota while tenant B serves sequentially — B must
+        see ZERO shed (admission or deadline) and every B answer must be
+        exact. Isolation is structural (separate queues), not a
+        scheduling outcome."""
+        rng = np.random.default_rng(7)
+        flood_pts = rand_points(rng, 200)
+        victim_pts = rand_points(rng, 100)
+        ref = ref_join(victim_pts, grid, index_a)
+        with make_router(grid, store, max_resident=2) as router:
+            router.add_tenant("aggressor", index_a, RES, queue_capacity=2)
+            router.add_tenant("victim", index_a, RES, queue_capacity=32)
+
+            futures, stop = [], threading.Event()
+
+            def flood():
+                while not stop.is_set():
+                    try:
+                        futures.append(
+                            router.submit(
+                                "aggressor", flood_pts, deadline_s=0.05
+                            )
+                        )
+                    except Overloaded:
+                        pass
+
+            th = threading.Thread(target=flood, daemon=True)  # lint: thread-context-adoption-ok (flood thread asserts only router-side counters; no telemetry/fault context needed)
+            th.start()
+            try:
+                for _ in range(15):
+                    np.testing.assert_array_equal(
+                        router.join("victim", victim_pts), ref
+                    )
+            finally:
+                stop.set()
+                th.join(timeout=10)
+            settle(futures)
+
+            m = router.metrics()["tenants"]
+            assert m["aggressor"]["shed_admit_router"] > 0
+            assert m["victim"]["shed_admit_router"] == 0
+            assert m["victim"]["shed"] == 0
+            assert m["victim"]["cold_compiles"] == 0
+
+    def test_simultaneous_overload_accounts_per_tenant(
+        self, grid, store, index_a
+    ):
+        """Both tenants overload at once: each tenant's sheds land in
+        its own ledger, matching what its own caller observed — no
+        cross-tenant attribution."""
+        rng = np.random.default_rng(8)
+        pts = rand_points(rng, 200)
+        observed = {"x": 0, "y": 0}
+        reasons = set()
+        with make_router(grid, store, max_resident=2) as router:
+            for name in observed:
+                router.add_tenant(name, index_a, RES, queue_capacity=2)
+
+            def flood(name):
+                futures = []
+                for _ in range(40):
+                    try:
+                        futures.append(
+                            router.submit(name, pts, deadline_s=0.05)
+                        )
+                    except Overloaded as e:
+                        observed[name] += 1
+                        reasons.add(e.reason)
+                settle(futures)
+
+            threads = [
+                threading.Thread(target=flood, args=(n,))  # lint: thread-context-adoption-ok (per-tenant flood asserts caller-observed counts only)
+                for n in observed
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+
+            m = router.metrics()["tenants"]
+            for name, n_observed in observed.items():
+                assert n_observed > 0, f"{name} never overloaded"
+                assert m[name]["shed_admit_router"] == n_observed
+            assert reasons <= {"queue_full", "deadline"}
+
+
+class TestSwapUnderLoad:
+    def test_swap_mid_stream_is_bit_exact(
+        self, grid, store, index_a, index_b
+    ):
+        """hot_swap through the router while submits stream: every
+        answer must exactly match one of the two index snapshots (never
+        a torn mix), the post-swap answer must come from the new index,
+        and the swap introduces zero cold compiles."""
+        rng = np.random.default_rng(9)
+        pts = rand_points(rng, 100)
+        ref_a = ref_join(pts, grid, index_a)
+        ref_b = ref_join(pts, grid, index_b)
+        assert not np.array_equal(ref_a, ref_b)  # swap must be observable
+
+        with make_router(grid, store) as router:
+            router.add_tenant("t", index_a, RES, queue_capacity=128)
+            futures, stop = [], threading.Event()
+
+            def stream():
+                while not stop.is_set():
+                    try:
+                        futures.append(router.submit("t", pts))
+                    except Overloaded:
+                        pass
+                    time.sleep(0.002)
+
+            th = threading.Thread(target=stream, daemon=True)  # lint: thread-context-adoption-ok (load generator; results compared on the caller thread)
+            th.start()
+            try:
+                time.sleep(0.05)
+                stats = router.swap("t", index_b)
+            finally:
+                stop.set()
+                th.join(timeout=10)
+
+            assert stats["buckets"] == 3  # new core warmed every rung
+            results = []
+            for f in futures:
+                try:
+                    results.append(np.asarray(f.result(timeout=10)))
+                except Overloaded:
+                    pass
+            assert results, "stream produced no answers"
+            for r in results:
+                assert (
+                    np.array_equal(r, ref_a) or np.array_equal(r, ref_b)
+                ), "answer matches neither snapshot — torn swap"
+            np.testing.assert_array_equal(router.join("t", pts), ref_b)
+            assert router.metrics()["tenants"]["t"]["cold_compiles"] == 0
+
+
+class TestFaultSites:
+    def test_router_admit_site_injects(self, grid, store, index_a):
+        with make_router(grid, store) as router:
+            router.add_tenant("a", index_a, RES)
+            pts = np.zeros((4, 2))
+            with faults.transient_errors(1, sites=("router.admit",)):
+                with pytest.raises(TransientDeviceError):
+                    router.submit("a", pts)
+            router.join("a", pts)  # budget consumed; serving resumes
+
+    def test_router_evict_site_injects(self, grid, store, index_a):
+        with make_router(grid, store) as router:
+            router.add_tenant("a", index_a, RES)
+            with faults.transient_errors(1, sites=("router.evict",)):
+                with pytest.raises(TransientDeviceError):
+                    router.evict("a")
+            # the failed evict left the engine resident and serving
+            assert router.metrics()["tenants"]["a"]["resident"]
+            router.evict("a")
+            assert not router.metrics()["tenants"]["a"]["resident"]
+
+    def test_router_swap_site_failure_keeps_old_snapshot(
+        self, grid, store, index_a, index_b
+    ):
+        """A fault at router.swap must leave the tenant serving the OLD
+        index bit-identically — swap is all-or-nothing."""
+        rng = np.random.default_rng(10)
+        pts = rand_points(rng, 64)
+        ref_a = ref_join(pts, grid, index_a)
+        with make_router(grid, store) as router:
+            router.add_tenant("a", index_a, RES)
+            with faults.transient_errors(1, sites=("router.swap",)):
+                with pytest.raises(TransientDeviceError):
+                    router.swap("a", index_b)
+            np.testing.assert_array_equal(router.join("a", pts), ref_a)
+
+
+# --------------------------------------------------- _CoreCache surface
+
+class _FakeCore:
+    def __init__(self, warmed):
+        self.warmed = warmed
+
+
+class TestCoreCache:
+    def test_cold_evicted_before_warm_regardless_of_recency(self):
+        c = _CoreCache(maxsize=2)
+        warm, cold = _FakeCore(True), _FakeCore(False)
+        c.put("warm", warm)
+        c.put("cold", cold)  # most recent, but never warmed
+        c.put("new", _FakeCore(True))
+        assert c.get("warm") is warm
+        assert c.get("cold") is None
+        assert c.extra_stats()["evictions"] == 1
+
+    def test_lru_order_among_warm(self):
+        c = _CoreCache(maxsize=2)
+        a, b = _FakeCore(True), _FakeCore(True)
+        c.put("a", a)
+        c.put("b", b)
+        c.get("a")  # refresh: b becomes the LRU
+        c.put("c", _FakeCore(True))
+        assert c.get("b") is None
+        assert c.get("a") is a
+
+    def test_lru_cache_protocol_and_extra_stats(self):
+        c = _CoreCache(maxsize=4)
+        c.put("k", _FakeCore(True))
+        c.get("k")
+        c.get("k")
+        info = c.cache_info()
+        assert (info.hits, info.misses) == (2, 1)
+        assert (info.maxsize, info.currsize) == (4, 1)
+        assert c.extra_stats() == {"evictions": 0, "occupancy": 0.25}
+        c.cache_clear()
+        info = c.cache_info()
+        assert (info.hits, info.misses, info.currsize) == (0, 0, 0)
+
+    def test_registered_in_dispatch_cache_registry(self):
+        """The satellite pin: batch-core residency is visible through
+        the SAME stats surface as every other dispatch cache, with the
+        occupancy-aware extras merged in."""
+        view = cache_view("batch_cores")
+        for key in (
+            "hits", "misses", "maxsize", "currsize",
+            "evictions", "occupancy",
+        ):
+            assert key in view
+        stats = cache_stats(emit=False)
+        assert "evictions" in stats["batch_cores"]
+        assert "occupancy" in stats["batch_cores"]

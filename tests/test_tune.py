@@ -46,6 +46,7 @@ from mosaic_tpu.tune import (
     TuningProfile,
     WorkloadProfile,
     index_fingerprint,
+    profile_overlay,
     profile_points,
     profile_polygons,
     profile_raster,
@@ -737,3 +738,39 @@ class TestOverlayCandidateTelemetry:
         ]
         assert ev["candidates"] == 0
         assert ev["sure_fraction"] == 0.0
+
+
+class TestOverlayProfile:
+    def test_overlay_profile_consumes_span_stats(self, zones):
+        """PR 16 satellite: `profile_overlay` reads the sure/border
+        split straight off the ``overlay.candidates`` span — no second
+        pass over the tables."""
+        with telemetry.capture() as events:
+            prof = profile_overlay(zones, zones, CUSTOM, RES)
+        assert prof.kind == "overlay" and prof.n_sampled > 0
+        assert prof.resolution == RES
+        assert 0.0 <= prof.sure_fraction <= 1.0
+        assert abs(prof.sure_fraction + prof.border_fraction - 1.0) < 1e-6
+        assert [e for e in events if e.get("event") == "tune_profile"]
+        assert WorkloadProfile.from_dict(prof.as_dict()) == prof
+
+    def test_border_dominated_recommends_finer_tessellation(self):
+        prof = WorkloadProfile(
+            kind="overlay", n_sampled=100, resolution=3,
+            sure_fraction=0.2, border_fraction=0.8,
+        )
+        rec = recommend(prof, priors={})
+        assert rec.resolution == 4
+        (rule,) = [r for r in rec.rationale if r["knob"] == "resolution"]
+        assert rule["rule"] == "border-dominated-finer-tessellation"
+        assert rule["evidence"]["border_fraction"] == 0.8
+        assert rule["evidence"]["threshold"] == 0.5
+
+    def test_sure_dominated_keeps_resolution(self):
+        prof = WorkloadProfile(
+            kind="overlay", n_sampled=100, resolution=3,
+            sure_fraction=0.9, border_fraction=0.1,
+        )
+        rec = recommend(prof, priors={})
+        assert rec.resolution is None
+        assert not [r for r in rec.rationale if r["knob"] == "resolution"]

@@ -41,6 +41,8 @@ PATTERNS = (
     "RASTER_r*.json",
     "STALL_r*.json",
     "TUNE_r*.json",
+    "SERVE_RESTART_r*.json",
+    "SERVE_TENANT_r*.json",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)$")

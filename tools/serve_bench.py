@@ -42,6 +42,182 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def _tenant_ab(index, h3, bbox, args, detail) -> float:
+    """The --tenants lane: tenant 0 floods at ``--aggressor-mult`` x the
+    base rate while tenants 1..N-1 run at the base rate, once against a
+    :class:`ServeRouter` (hard isolation: per-tenant queues/deadlines)
+    and once against a single shared-queue engine. Per-tenant admission,
+    shed-by-reason counts, and client-side latency percentiles land in
+    ``detail``; returns the isolated lane's worst VICTIM shed rate (the
+    headline — structurally ~0, because the aggressor cannot occupy a
+    victim's quota)."""
+    import concurrent.futures as cf
+    import tempfile
+
+    from bench import RES
+    from mosaic_tpu.runtime import telemetry
+    from mosaic_tpu.runtime.errors import Overloaded
+    from mosaic_tpu.serve import BucketLadder, ServeEngine, ServeRouter
+
+    n = args.tenants
+    mult = args.aggressor_mult
+    reqs = {}
+    for t in range(n):
+        r = np.random.default_rng(args.seed + t)
+        count = int(args.requests * (mult if t == 0 else 1))
+        sizes = r.integers(args.rows_min, args.rows_max + 1, count)
+        reqs[t] = [
+            r.uniform(bbox[:2], bbox[2:], (int(k), 2)) for k in sizes
+        ]
+    rates = {t: args.rate * (mult if t == 0 else 1.0) for t in range(n)}
+
+    def load(submit):
+        """Open-loop Poisson per tenant; latency stamped by the future's
+        done-callback (completion time, not drain time)."""
+        stats = {
+            t: {"admitted": 0, "shed_submit": 0, "shed_deadline": 0,
+                "shed_other": 0, "lat": []}
+            for t in range(n)
+        }
+        lock = threading.Lock()
+        futures: list = []
+        sinks = telemetry.current_sinks()
+
+        def worker(t):
+            # router_stage.admit is recorded on the submitting thread;
+            # adopting the caller's sinks puts it in the bench trail
+            telemetry.adopt_sinks(sinks)
+            r = np.random.default_rng(1000 + t)
+            next_t = time.perf_counter()
+            for pts in reqs[t]:
+                next_t += float(r.exponential(1.0 / rates[t]))
+                lag = next_t - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                t0 = time.perf_counter()
+                try:
+                    f = submit(t, pts)
+                except Overloaded:
+                    with lock:
+                        stats[t]["shed_submit"] += 1
+                    continue
+                with lock:
+                    stats[t]["admitted"] += 1
+                    futures.append(f)
+
+                def done(f, t=t, t0=t0):
+                    dt = time.perf_counter() - t0
+                    exc = f.exception()
+                    with lock:
+                        if exc is None:
+                            stats[t]["lat"].append(dt)
+                        elif (
+                            isinstance(exc, Overloaded)
+                            and exc.reason == "deadline"
+                        ):
+                            stats[t]["shed_deadline"] += 1
+                        else:
+                            stats[t]["shed_other"] += 1
+
+                f.add_done_callback(done)
+
+        threads = [
+            threading.Thread(target=worker, args=(t,), daemon=True)  # lint: thread-context-adoption-ok (load generator: client-side latency only, no telemetry emitted on these threads)
+            for t in range(n)
+        ]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        cf.wait(futures)
+        wall = time.perf_counter() - t0
+        per = {}
+        for t in range(n):
+            s = stats[t]
+            lat = np.asarray(s["lat"])
+            total = len(reqs[t])
+            per[f"tenant_{t}"] = {
+                "requests": total,
+                "admitted": s["admitted"],
+                "completed": int(lat.size),
+                "shed_submit": s["shed_submit"],
+                "shed_deadline": s["shed_deadline"],
+                "shed_other": s["shed_other"],
+                "shed_rate": round(
+                    (s["shed_submit"] + s["shed_deadline"]) / max(total, 1),
+                    4,
+                ),
+                "p50": round(float(np.percentile(lat, 50)), 6)
+                if lat.size else None,
+                "p99": round(float(np.percentile(lat, 99)), 6)
+                if lat.size else None,
+            }
+        return per, wall
+
+    ekw = dict(
+        ladder=BucketLadder(args.min_bucket, args.max_bucket),
+        max_batch_rows=min(args.max_batch, args.max_bucket),
+        max_wait_s=args.window_ms / 1e3,
+        queue_capacity=args.queue_cap,
+        default_deadline_s=args.deadline_ms / 1e3,
+        bounds=bbox,
+    )
+
+    # isolated: per-tenant engines behind the router; the shared AOT
+    # store means tenant 0 exports the ladder once and every other
+    # tenant warms by loading it
+    store = tempfile.mkdtemp(prefix="serve_tenants_")
+    router = ServeRouter(
+        h3, max_resident=n, program_store=store, engine_defaults=ekw,
+    )
+    t0 = time.perf_counter()
+    warm = {}
+    for t in range(n):
+        warm[f"tenant_{t}"] = router.add_tenant(
+            f"tenant_{t}", index, RES
+        ).get("aot")
+    warm_wall = time.perf_counter() - t0
+    iso_per, iso_wall = load(
+        lambda t, pts: router.submit(f"tenant_{t}", pts)
+    )
+    rm = router.metrics()
+    router_shed = {
+        name: {
+            "submitted": m["submitted_router"],
+            "shed_admit": m["shed_admit_router"],
+        }
+        for name, m in rm["tenants"].items()
+    }
+    router.close()
+
+    # shared: one engine, one queue — every tenant behind the aggressor
+    eng = ServeEngine(index, h3, RES, **ekw)
+    eng.warmup()
+    sh_per, sh_wall = load(lambda t, pts: eng.submit(pts))
+    eng.close()
+
+    victims = [f"tenant_{t}" for t in range(1, n)]
+    iso_victim = max(iso_per[v]["shed_rate"] for v in victims)
+    sh_victim = max(sh_per[v]["shed_rate"] for v in victims)
+    detail.update(
+        tenants=n,
+        aggressor="tenant_0",
+        aggressor_mult=mult,
+        rate_per_tenant=args.rate,
+        isolated={
+            "per_tenant": iso_per,
+            "router_shed": router_shed,
+            "warmup": {"aot": warm, "wall_s": round(warm_wall, 3)},
+            "resident": rm["resident"],
+            "wall_s": round(iso_wall, 3),
+        },
+        shared={"per_tenant": sh_per, "wall_s": round(sh_wall, 3)},
+        victim_shed_rate={"isolated": iso_victim, "shared": sh_victim},
+    )
+    return iso_victim
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mode", choices=("closed", "open"), default="closed")
@@ -60,6 +236,16 @@ def main() -> None:
     ap.add_argument("--min-bucket", type=int, default=64)
     ap.add_argument("--max-bucket", type=int, default=16384)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--tenants", type=int, default=0,
+                    help=">= 2 runs the multi-tenant isolation A/B lane "
+                    "instead of the single-engine bench: tenant 0 floods "
+                    "at --aggressor-mult x the base rate, once against a "
+                    "ServeRouter (per-tenant queues) and once against one "
+                    "shared-queue engine; per-tenant shed counts and "
+                    "latency land in the final JSON")
+    ap.add_argument("--aggressor-mult", type=float, default=10.0,
+                    help="tenant 0's rate/request multiplier in the "
+                    "--tenants lane")
     ap.add_argument("--poison", type=int, default=0,
                     help="inject N NaN rows into one request "
                     "(quarantine demo lane)")
@@ -109,6 +295,30 @@ def main() -> None:
         detail.update(
             device=str(jax.devices()[0]), zones=zones_src, mode=args.mode,
         )
+
+        if args.tenants >= 2:
+            # multi-tenant isolation A/B: the headline is the WORST
+            # victim shed rate under per-tenant queues (should be ~0
+            # while the shared-queue lane's victims shed at the
+            # aggressor's mercy)
+            line["metric"], line["unit"] = "victim_shed_rate", "fraction"
+            with telemetry.capture() as events:
+                line["value"] = _tenant_ab(index, h3, bbox, args, detail)
+            if args.trail or args.chrome_trace:
+                from mosaic_tpu import obs
+
+                if args.trail:
+                    obs.write_jsonl(events, args.trail)
+                if args.chrome_trace:
+                    obs.write_chrome_trace(events, args.chrome_trace)
+            detail["total_wall_s"] = round(time.perf_counter() - t_all, 1)
+            out = json.dumps(line)
+            emit_to.write(out + "\n")
+            emit_to.flush()
+            if args.out:
+                with open(args.out, "w") as f:
+                    f.write(out + "\n")
+            return
 
         engine = ServeEngine(
             index, h3, RES,
