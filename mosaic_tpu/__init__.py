@@ -21,6 +21,23 @@ import os as _os
 if not _os.environ.get("MOSAIC_TPU_NO_X64"):
     _jax.config.update("jax_enable_x64", True)
 
+# The f64 oracle contract (device results bit-identical to the numpy
+# twins) requires that XLA:CPU round every multiply — LLVM's default
+# fp-contract fuses ``a*b - c*d`` into a single-rounding FMA, which
+# diverges from numpy by 1 ulp on patterns like the overlay clip's cross
+# products. Capping CPU codegen at AVX (no FMA3) restores IEEE op-for-op
+# rounding; TPU/GPU lanes are unaffected (their accelerated dtypes are
+# covered by the epsilon-band host recheck instead). Opt out with
+# MOSAIC_TPU_ALLOW_FMA=1 or by setting xla_cpu_max_isa yourself; must
+# run before the first XLA compilation to take effect.
+if (
+    not _os.environ.get("MOSAIC_TPU_ALLOW_FMA")
+    and "xla_cpu_max_isa" not in _os.environ.get("XLA_FLAGS", "")
+):
+    _os.environ["XLA_FLAGS"] = (
+        _os.environ.get("XLA_FLAGS", "") + " --xla_cpu_max_isa=AVX"
+    ).strip()
+
 from .core.types import GeometryBuilder, GeometryType, PackedGeometry, PaddedGeometry
 from .context import MosaicConfig, MosaicContext, index_system_factory
 from .runtime.errors import (

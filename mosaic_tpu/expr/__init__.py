@@ -39,15 +39,20 @@ from .ast import (  # noqa: F401
     cell_of,
     const,
     in_zone,
+    left_area,
     mask_where,
     ndvi,
     norm_diff,
+    overlap_area,
+    overlap_fraction,
+    right_area,
     structure_key,
     terminal_of,
     tree_hash,
     uses_cells,
     uses_zones,
     validate,
+    validate_pair,
     where,
     zone_data,
 )

@@ -67,8 +67,11 @@ __all__ = [
     "Expr",
     "InZone",
     "Join",
+    "LeftArea",
     "MaskWhere",
     "Not",
+    "OverlapArea",
+    "RightArea",
     "Where",
     "ZoneData",
     "Zonal",
@@ -77,15 +80,20 @@ __all__ = [
     "cell_of",
     "const",
     "in_zone",
+    "left_area",
     "mask_where",
     "ndvi",
     "norm_diff",
+    "overlap_area",
+    "overlap_fraction",
+    "right_area",
     "structure_key",
     "terminal_of",
     "tree_hash",
     "uses_cells",
     "uses_zones",
     "validate",
+    "validate_pair",
     "walk",
     "where",
     "zone_data",
@@ -299,6 +307,36 @@ class ZoneData(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class OverlapArea(Expr):
+    """Overlay-join leaf: the intersection area of the candidate
+    geometry pair (summed over its shared-cell chip pairs by the device
+    fold). Only valid in PAIR trees (`sql.overlay.overlay_measures`),
+    never in raster trees — :func:`validate` rejects it there and
+    :func:`validate_pair` accepts it."""
+
+    def dtype(self) -> str:
+        return "f64"
+
+
+@dataclasses.dataclass(frozen=True)
+class LeftArea(Expr):
+    """Overlay-join leaf: the LEFT geometry's total area (pair trees
+    only) — the denominator of ``st_overlap_fraction``."""
+
+    def dtype(self) -> str:
+        return "f64"
+
+
+@dataclasses.dataclass(frozen=True)
+class RightArea(Expr):
+    """Overlay-join leaf: the RIGHT geometry's total area (pair trees
+    only)."""
+
+    def dtype(self) -> str:
+        return "f64"
+
+
+@dataclasses.dataclass(frozen=True)
 class Zonal(Expr):
     """Terminal: fold ``value`` into per-key (count, sum, min, max)."""
 
@@ -358,6 +396,25 @@ def cell_of() -> CellOf:
 
 def in_zone() -> InZone:
     return InZone()
+
+
+def overlap_area() -> OverlapArea:
+    return OverlapArea()
+
+
+def left_area() -> LeftArea:
+    return LeftArea()
+
+
+def right_area() -> RightArea:
+    return RightArea()
+
+
+def overlap_fraction() -> BinOp:
+    """``intersection_area / left_area`` — the overlay fraction measure,
+    one fixed operation order shared by the device lowering and the f64
+    host oracle (like :func:`norm_diff`)."""
+    return BinOp("div", OverlapArea(), LeftArea())
 
 
 def zone_data(values, fill: float = 0.0) -> ZoneData:
@@ -444,6 +501,12 @@ def structure_key(node: Expr):
         return ("cell_of",)
     if isinstance(node, InZone):
         return ("in_zone",)
+    if isinstance(node, OverlapArea):
+        return ("overlap_area",)
+    if isinstance(node, LeftArea):
+        return ("left_area",)
+    if isinstance(node, RightArea):
+        return ("right_area",)
     if isinstance(node, ZoneData):
         return (
             "zone_data",
@@ -524,6 +587,11 @@ def validate(
             raise TypeError("Where condition must be bool")
         if isinstance(n, MaskWhere) and n.cond.dtype() != "bool":
             raise TypeError("mask_where condition must be bool")
+        if isinstance(n, (OverlapArea, LeftArea, RightArea)):
+            raise ValueError(
+                f"{type(n).__name__} is an overlay-pair leaf — it only "
+                "appears in pair trees (sql.overlay.overlay_measures)"
+            )
         if isinstance(n, (InZone, ZoneData)):
             if not has_zones:
                 raise ValueError(
@@ -539,5 +607,39 @@ def validate(
         raise TypeError(
             "a zonal fold needs a numeric value tree (fold bools via "
             "Where(cond, 1.0, 0.0))"
+        )
+    return node
+
+
+#: node families allowed in an overlay PAIR tree: the three pair leaves
+#: plus pure per-pair scalar algebra — no raster/zone machinery
+_PAIR_NODES = (
+    Const, BinOp, Compare, BoolOp, Not, Where, MaskWhere,
+    OverlapArea, LeftArea, RightArea,
+)
+
+
+def validate_pair(node: Expr) -> Expr:
+    """Check a tree for the overlay pair lane: pair leaves plus scalar
+    algebra only, no terminal, numeric root (the per-pair value the
+    measures result carries). Returns the node for chaining."""
+    for n in walk(node):
+        if not isinstance(n, _PAIR_NODES):
+            raise ValueError(
+                f"{type(n).__name__} cannot appear in an overlay pair "
+                "tree — allowed: Const/BinOp/Compare/BoolOp/Not/Where/"
+                "MaskWhere over OverlapArea/LeftArea/RightArea"
+            )
+        if isinstance(n, (BinOp, Compare)):
+            for side in (n.a, n.b):
+                if side.dtype() == "bool":
+                    raise TypeError(
+                        f"{type(n).__name__}({n.op!r}) needs numeric "
+                        "operands; got a bool tree"
+                    )
+    if node.dtype() == "bool":
+        raise TypeError(
+            "an overlay pair tree must produce a numeric per-pair value "
+            "(wrap predicates in Where(cond, 1.0, 0.0))"
         )
     return node

@@ -45,10 +45,14 @@ from ..runtime import telemetry as _telemetry
 from . import ast
 
 __all__ = [
+    "PairCtx",
     "cold_compiles",
     "freeze",
+    "overlay_program",
+    "overlay_signature_of",
     "pixel_program",
     "run_pixels",
+    "run_tracked",
     "run_zonal",
     "signature_of",
     "signatures",
@@ -158,6 +162,56 @@ def _band_rows(value: ast.Expr) -> dict:
     return {b: r for r, b in enumerate(ast.bands_of(value))}
 
 
+class PairCtx:
+    """Lowering context for overlay PAIR trees: per-unique-pair (S,)
+    tables — the folded intersection area and the two geometry total
+    areas. Shared shape with `expr.host_oracle.interpret_pair`."""
+
+    def __init__(self, area, larea, rarea):
+        self.area = area
+        self.larea = larea
+        self.rarea = rarea
+
+
+def _lower_pair(node: ast.Expr, ctx: PairCtx):
+    """→ (value, valid) jnp arrays over the per-pair tables — the pair-
+    tree twin of :func:`_lower`, with the same operator maps so the f64
+    host oracle (`interpret_pair`) mirrors it op for op."""
+    true = jnp.ones((), bool)
+    if isinstance(node, ast.Const):
+        return jnp.asarray(node.value, jnp.float64), true
+    if isinstance(node, ast.OverlapArea):
+        return ctx.area, true
+    if isinstance(node, ast.LeftArea):
+        return ctx.larea, true
+    if isinstance(node, ast.RightArea):
+        return ctx.rarea, true
+    if isinstance(node, (ast.BinOp, ast.Compare)):
+        av, am = _lower_pair(node.a, ctx)
+        bv, bm = _lower_pair(node.b, ctx)
+        fn = _BIN[node.op] if isinstance(node, ast.BinOp) else _CMP[node.op]
+        return fn(av, bv), am & bm
+    if isinstance(node, ast.BoolOp):
+        av, am = _lower_pair(node.a, ctx)
+        bv, bm = _lower_pair(node.b, ctx)
+        return (av & bv) if node.op == "and" else (av | bv), am & bm
+    if isinstance(node, ast.Not):
+        av, am = _lower_pair(node.a, ctx)
+        return ~av, am
+    if isinstance(node, ast.Where):
+        cv, cm = _lower_pair(node.cond, ctx)
+        av, am = _lower_pair(node.a, ctx)
+        bv, bm = _lower_pair(node.b, ctx)
+        return jnp.where(cv, av, bv), cm & jnp.where(cv, am, bm)
+    if isinstance(node, ast.MaskWhere):
+        vv, vm = _lower_pair(node.value, ctx)
+        cv, cm = _lower_pair(node.cond, ctx)
+        return vv, vm & cm & cv
+    raise TypeError(
+        f"cannot lower {type(node).__name__} in an overlay pair tree"
+    )
+
+
 # ------------------------------------------------------------- programs
 
 
@@ -209,6 +263,92 @@ def pixel_program(
         )
 
     return jax.jit(pixels)
+
+
+@_dispatch.bounded_cache("overlay_programs", 64)
+def overlay_program(
+    value: ast.Expr, Lb: int, Rb: int, Pb: int, Sb: int, vpad: int,
+    acc_name: str, mesh=None,
+):
+    """The fused overlay measure program: gather candidate chip pairs
+    from the two sorted side tables, compute per-pair intersection areas
+    (kind routing + convex clip, `kernels.overlay.pair_areas`), fold
+    them into per-geometry-pair totals, and evaluate the pair tree over
+    the folded tables — ONE launch per ``(tree, buckets, mesh)``
+    signature. Under ``mesh`` the per-pair stage runs data-parallel over
+    the pair axis (side tables replicated, candidates sharded) — the
+    stage is pointwise in the pair axis and the fold runs on the
+    gathered output, so a sharded run is bit-identical to single-device
+    by construction."""
+    acc_dt = jnp.dtype(acc_name)
+    from ..kernels import overlay as _ko
+
+    def per_pair(li, ri, lcore, lok, lverts, lvlen, larea, lcell,
+                 rcore, rok, rverts, rvlen, rarea, band):
+        return _ko.pair_areas(
+            lcore[li], rcore[ri], lok[li], rok[ri],
+            lverts[li], lvlen[li], rverts[ri], rvlen[ri],
+            larea[li], rarea[ri], lcell[li], band, xp=jnp,
+        )
+
+    stage = per_pair
+    regather = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel._compat import shard_map as _shard_map
+
+        p, r = P(mesh.axis_names), P()
+        stage = _shard_map(
+            per_pair, mesh=mesh,
+            in_specs=(p, p, r, r, r, r, r, r, r, r, r, r, r, r),
+            out_specs=(p, p), check_rep=False,
+        )
+        # replicate the per-pair outputs before the fold: left sharded,
+        # GSPMD would split the segment sum into per-shard partials plus
+        # a cross-shard combine — a different f64 accumulation order
+        # (1-ulp reassociation drift vs single-device)
+        regather = NamedSharding(mesh, r)
+
+    def fused(li, ri, valid, seg, lcore, lok, lverts, lvlen, larea,
+              lcell, rcore, rok, rverts, rvlen, rarea, seg_larea,
+              seg_rarea, band):
+        area, host_needed = stage(
+            li, ri, lcore, lok, lverts, lvlen, larea, lcell,
+            rcore, rok, rverts, rvlen, rarea, band,
+        )
+        if regather is not None:
+            area = jax.lax.with_sharding_constraint(area, regather)
+            host_needed = jax.lax.with_sharding_constraint(
+                host_needed, regather
+            )
+        cnt, s, _mn, _mx = zonal_fold_masked(
+            area, valid, seg, Sb, acc_dtype=acc_dt
+        )
+        val, vok = _lower_pair(value, PairCtx(s, seg_larea, seg_rarea))
+        return (
+            jnp.broadcast_to(val, (Sb,)).astype(jnp.float64),
+            jnp.broadcast_to(vok, (Sb,)),
+            s, cnt, host_needed,
+        )
+
+    return jax.jit(fused)
+
+
+def overlay_signature_of(
+    value: ast.Expr, Lb: int, Rb: int, Pb: int, Sb: int, vpad: int,
+    acc_name: str, index_system, resolution, mesh=None,
+) -> tuple:
+    """The dispatch signature an overlay measure execution is tracked
+    under: ``(tree-hash, buckets, index, mesh)`` — the overlay twin of
+    :func:`signature_of`."""
+    return (
+        "overlay:" + ast.tree_hash(value)[:16],
+        (int(Lb), int(Rb), int(Pb), int(Sb), int(vpad), str(acc_name)),
+        (type(index_system).__name__, int(resolution)),
+        _dispatch.mesh_key(mesh),
+    )
 
 
 # ------------------------------------- signature tracking (the tripwire)
@@ -285,6 +425,18 @@ def _untrack(span, c0):
     if c0 is not None and c1 is not None:
         span.set(backend_compiles=c1 - c0)
     span.end()
+
+
+def run_tracked(sig: tuple, fn, *args):
+    """Execute any compiled program under expr signature tracking — the
+    public wrapper overlay dispatch uses so its cold compiles land in
+    the same `dispatch.compile` span / post-freeze tripwire as the
+    raster programs."""
+    span, c0 = _track(sig)
+    try:
+        return fn(*args)
+    finally:
+        _untrack(span, c0)
 
 
 def run_zonal_async(prog, sig: tuple, gt, origin, vals, mask, seg):

@@ -39,7 +39,11 @@ __all__ = [
     "host_expr_tile_partial",
     "host_expr_zonal_oracle",
     "host_fold_partial",
+    "host_overlay_measures",
+    "host_pair_override",
     "interpret",
+    "interpret_pair",
+    "splice_override",
 ]
 
 _BIN = {
@@ -229,6 +233,190 @@ def host_expr_tile_partial(
 
 def _band_rows(value: ast.Expr) -> dict:
     return {b: r for r, b in enumerate(ast.bands_of(value))}
+
+
+def interpret_pair(node: ast.Expr, area, larea, rarea):
+    """→ (value, valid) numpy arrays over per-pair tables — the f64
+    mirror of `expr.compile._lower_pair`, op for op (div by zero under
+    errstate-ignore so the oracle reaches the same inf/NaN bits)."""
+    true = np.True_
+    if isinstance(node, ast.Const):
+        return np.float64(node.value), true
+    if isinstance(node, ast.OverlapArea):
+        return area, true
+    if isinstance(node, ast.LeftArea):
+        return larea, true
+    if isinstance(node, ast.RightArea):
+        return rarea, true
+    if isinstance(node, (ast.BinOp, ast.Compare)):
+        av, am = interpret_pair(node.a, area, larea, rarea)
+        bv, bm = interpret_pair(node.b, area, larea, rarea)
+        fn = _BIN[node.op] if isinstance(node, ast.BinOp) else _CMP[node.op]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            return fn(av, bv), am & bm
+    if isinstance(node, ast.BoolOp):
+        av, am = interpret_pair(node.a, area, larea, rarea)
+        bv, bm = interpret_pair(node.b, area, larea, rarea)
+        return (av & bv) if node.op == "and" else (av | bv), am & bm
+    if isinstance(node, ast.Not):
+        av, am = interpret_pair(node.a, area, larea, rarea)
+        return ~av, am
+    if isinstance(node, ast.Where):
+        cv, cm = interpret_pair(node.cond, area, larea, rarea)
+        av, am = interpret_pair(node.a, area, larea, rarea)
+        bv, bm = interpret_pair(node.b, area, larea, rarea)
+        return np.where(cv, av, bv), cm & np.where(cv, am, bm)
+    if isinstance(node, ast.MaskWhere):
+        vv, vm = interpret_pair(node.value, area, larea, rarea)
+        cv, cm = interpret_pair(node.cond, area, larea, rarea)
+        return vv, vm & cm & cv
+    raise TypeError(
+        f"cannot interpret {type(node).__name__} in an overlay pair tree"
+    )
+
+
+def _general_pair_area(prep, lk: int, rk: int) -> float:
+    """Exact f64 chip∩chip area through the native boolean-op engine —
+    the catch-all for shapes the convex clip cannot answer (multi-ring,
+    holed, over-pad, spilled)."""
+    from ..core.geometry import hostops as _hostops
+    from ..sql.overlay import _csr_geom_areas
+
+    L, R = prep.left, prep.right
+    ga = L.table.chips.take(np.asarray([int(L.rows[lk])]))
+    gb = R.table.chips.take(np.asarray([int(R.rows[rk])]))
+    inter = _hostops.intersection(ga, gb)
+    return float(_csr_geom_areas(inter, prep.shift)[0])
+
+
+def host_pair_override(prep, li, ri, valid, seg, flagged):
+    """Whole-pair f64 re-answer for the flagged geometry pairs.
+
+    For every candidate row of a flagged pair, recompute its area in
+    pure f64 (cell/chip area tables for core kinds, the numpy twin of
+    the convex clip for clippable border pairs, the native boolean-op
+    engine otherwise) and accumulate per pair IN EMISSION ORDER — the
+    same stream order both fold lanes use. Returns (len(flagged),) f64
+    sums aligned with ``flagged``."""
+    from ..kernels import overlay as _k
+
+    flagged = np.asarray(flagged, np.int64)
+    out = np.zeros(flagged.shape[0], np.float64)
+    L, R = prep.left, prep.right
+    seg = np.asarray(seg)
+    mask = np.asarray(valid, bool) & (seg >= 0) & np.isin(seg, flagged)
+    rows = np.nonzero(mask)[0]
+    if not rows.size:
+        return out
+    lk = np.asarray(li, np.int64)[rows]
+    rk = np.asarray(ri, np.int64)[rows]
+    # ``flagged`` comes out of np.unique (sorted), so searchsorted maps
+    # each row to its pair slot; np.add.at over ascending ``rows`` then
+    # accumulates each pair's rows in emission order, the same order a
+    # per-row python loop (and both fold lanes) would use
+    pos = np.searchsorted(flagged, seg[rows])
+    lcore, rcore = L.core[lk], R.core[rk]
+    areas = np.zeros(rows.shape[0], np.float64)
+    cc = lcore & rcore
+    areas[cc] = L.cell_area[lk[cc]]
+    cb = lcore & ~rcore
+    areas[cb] = R.chip_area[rk[cb]]
+    bc = ~lcore & rcore
+    areas[bc] = L.chip_area[lk[bc]]
+    bb = ~lcore & ~rcore
+    ok = bb & L.ok_subj[lk] & R.ok_win[rk]
+    general = np.nonzero(bb & ~ok)[0]
+    if ok.any():
+        # one batched numpy clip over every clippable row — elementwise
+        # per row, so bit-identical to clipping them one at a time
+        ar, _, sp = _k.clip_area_convex(
+            L.verts[lk[ok]], L.vlen[lk[ok]],
+            R.verts[rk[ok]], R.vlen[rk[ok]], xp=np,
+        )
+        areas[ok] = ar
+        spilled = np.nonzero(ok)[0][np.asarray(sp, bool)]
+        general = np.concatenate([general, spilled])
+    for idx in general.tolist():
+        # the rare catch-all: multi-ring / holed / over-pad shapes go
+        # through the native boolean-op engine one pair at a time
+        areas[idx] = _general_pair_area(prep, int(lk[idx]), int(rk[idx]))
+    np.add.at(out, pos, areas)
+    return out
+
+
+def splice_override(prep, value, li, ri, valid, seg, host_needed,
+                    seg_l64, seg_r64, val, vok, area64):
+    """Replace every host-flagged pair's folded area AND evaluated value
+    with the pure-f64 re-answer (shared by the device lane and its numpy
+    twin, so both lanes splice identically). Returns ``(val, vok,
+    area64, n_overridden)``."""
+    seg = np.asarray(seg)
+    flag_rows = (
+        np.asarray(valid, bool) & (seg >= 0) & np.asarray(host_needed)
+    )
+    flagged = np.unique(seg[flag_rows])
+    if not flagged.size:
+        return val, vok, area64, 0
+    over = host_pair_override(prep, li, ri, valid, seg, flagged)
+    area64[flagged] = over
+    fv, fm = interpret_pair(
+        value, over, seg_l64[flagged], seg_r64[flagged]
+    )
+    val[flagged] = np.broadcast_to(
+        np.asarray(fv, np.float64), flagged.shape
+    )
+    vok[flagged] = np.broadcast_to(np.asarray(fm, bool), flagged.shape)
+    return val, vok, area64, int(flagged.size)
+
+
+def host_overlay_measures(prep, value: ast.Expr, *, pair_cap=None):
+    """Pure-host overlay measure lane: the numpy twin (``xp=np``) of the
+    device pipeline, stage for stage — equi-join count/emission, kind-
+    routed clip areas in the prep's accelerated dtype (so the host-
+    recheck flags match), the sequential pair fold, the pair-tree
+    interpretation, and the same f64 override splice. Under x64 this IS
+    the pure-f64 oracle the device lane must match bit for bit; it is
+    also the degradation target when the device path fails. Returns the
+    lane-output dict `sql.overlay.overlay_measures` packages."""
+    from ..kernels import overlay as _k
+    from ..sql import overlay as _ov
+
+    L, R = prep.left, prep.right
+    total = int(_k.pair_count(L.cells, R.cells, L.n, xp=np))
+    Pb, emit_limit, overflow = _ov.pair_plan(total, pair_cap)
+    li, ri, valid = _k.emit_pairs(
+        L.cells, R.cells, L.n, emit_limit, Pb, xp=np
+    )
+    uniq, seg, sure, Sb, seg_l64, seg_r64 = _ov.pair_glue(
+        prep, li, ri, valid
+    )
+    acc = np.dtype(prep.acc_name)
+    area, host_needed = _k.pair_areas(
+        L.core[li], R.core[ri], L.ok_subj[li], R.ok_win[ri],
+        L.verts.astype(acc)[li], L.vlen[li],
+        R.verts.astype(acc)[ri], R.vlen[ri],
+        L.chip_area.astype(acc)[li], R.chip_area.astype(acc)[ri],
+        L.cell_area.astype(acc)[li], acc.type(prep.band), xp=np,
+    )
+    _cnt, s = _k.host_pair_fold(area, valid, seg, Sb, acc_dtype=acc)
+    fv, fm = interpret_pair(
+        value, s, seg_l64.astype(acc), seg_r64.astype(acc)
+    )
+    val = np.broadcast_to(
+        np.asarray(fv, np.float64), (Sb,)
+    ).astype(np.float64).copy()
+    vok = np.broadcast_to(np.asarray(fm, bool), (Sb,)).copy()
+    area64 = s.astype(np.float64).copy()
+    val, vok, area64, overridden = splice_override(
+        prep, value, li, ri, valid, seg, host_needed,
+        seg_l64, seg_r64, val, vok, area64,
+    )
+    U = uniq.shape[0]
+    return {
+        "pairs": uniq, "value": val[:U], "valid": vok[:U],
+        "area": area64[:U], "sure": sure, "overflow": overflow,
+        "host_overridden": overridden,
+    }
 
 
 def host_expr_zonal_oracle(

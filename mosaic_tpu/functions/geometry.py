@@ -33,7 +33,8 @@ __all__ = [
     "st_area", "st_length", "st_perimeter", "st_centroid", "st_centroid2D",
     "st_centroid2d", "st_centroid3D", "st_centroid3d", "st_envelope",
     "st_buffer", "st_bufferloop", "st_convexhull", "st_simplify",
-    "st_intersection", "st_union", "st_difference", "st_symdifference",
+    "st_intersection", "st_intersection_area", "st_overlap_fraction",
+    "st_union", "st_difference", "st_symdifference",
     "st_unaryunion", "st_dump", "flatten_polygons", "st_contains",
     "st_intersects", "st_distance", "st_geometrytype", "st_isvalid",
     "st_numpoints", "st_x", "st_y", "st_xmin", "st_xmax", "st_ymin",
@@ -485,6 +486,36 @@ def st_intersection(geom_a, geom_b, backend: str | None = None):
     """Row-wise boolean intersection (reference: ST_Intersection)."""
     a, fmt = coerce(geom_a)
     return like_input(_clipper(backend).intersection(a, to_packed(geom_b)), fmt)
+
+
+def st_intersection_area(geom_a, geom_b, index_system, resolution, **kw):
+    """Fused overlay join: per intersecting (left, right) geometry pair,
+    the exact intersection AREA — `sql.overlay.overlay_measures` with
+    the raw `expr.ast.overlap_area` tree (device candidates + clip,
+    f64 host recheck inside the epsilon band). Keyword options (`prep=`,
+    `pair_cap=`, `mesh=`, `lane=`) pass through; returns
+    `sql.overlay.OverlayMeasures`."""
+    from ..sql.overlay import overlay_measures
+
+    return overlay_measures(
+        to_packed(geom_a), to_packed(geom_b), index_system, resolution,
+        **kw,
+    )
+
+
+def st_overlap_fraction(geom_a, geom_b, index_system, resolution, **kw):
+    """Fused overlay join: per intersecting pair, the fraction of the
+    LEFT geometry covered by the right one (``overlap_area /
+    left_area``) — shared-edge touches report exactly 0.0 (the f64 host
+    lane decides every contact case). Returns
+    `sql.overlay.OverlayMeasures`."""
+    from ..expr.ast import overlap_fraction
+    from ..sql.overlay import overlay_measures
+
+    return overlay_measures(
+        to_packed(geom_a), to_packed(geom_b), index_system, resolution,
+        overlap_fraction(), **kw,
+    )
 
 
 def st_union(geom_a, geom_b, backend: str | None = None):

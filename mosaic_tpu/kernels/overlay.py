@@ -1,0 +1,319 @@
+"""Device-side overlay join kernels: sorted segment equi-join + clip area.
+
+The overlay candidate generator is the cell-id twin of the segment
+machinery `kernels/zonal.py` already uses — both chip tables arrive
+sorted by int64 cell id (a one-time host prep, amortized like the chip
+index build), and the per-query work runs on device:
+
+- :func:`pair_spans` / :func:`pair_count` — run-length segment spans via
+  two ``searchsorted`` probes of the right table per left row: the span
+  ``[lo, lo+cnt)`` of right rows sharing the left row's cell.
+- :func:`emit_pairs` — bounded CSR cross-join emission: pair rank ``k``
+  maps to its left row by a ``searchsorted`` over the exclusive span
+  offsets and to its right row by the in-span remainder, against a
+  STATIC pair bucket so the compiled program population stays on the
+  dispatch ladder. Caps are full-bucket: overflow is structural (the
+  caller truncates at an explicit cap and reports OVERFLOW(-2)
+  in-band), never an escalation.
+- :func:`clip_area_convex` — batched Sutherland–Hodgman clip area for
+  convex chip pairs, mirroring `core.tessellate.clip_rings_convex_batch`
+  operation for operation (same half-plane sign test, same ``denom``
+  guard, same parametric intersection formula) but with a STATIC output
+  width: convex ∩ convex emits at most ``Vs + Vw`` vertices, so the
+  buffer never grows. Consecutive duplicate vertices are NOT removed —
+  they contribute exactly 0.0 to the shoelace sum, and area is the only
+  consumer.
+
+Every kernel takes ``xp`` (jnp or numpy) and is written against the
+array-API subset the two share, so the f64 host twin used by the
+overlay oracle IS this code: elementwise IEEE ops agree bitwise between
+numpy and XLA CPU, integer searchsorted/cumsum/gather are exact, and
+the only scatter (:func:`_scatter_rows`) writes disjoint targets. The
+shoelace accumulation is an UNROLLED python loop over the static width
+on both sides — XLA preserves the float op order of an unrolled chain,
+which is what makes the device area bit-identical to the numpy twin
+under x64. The fold back to per-geometry-pair totals is
+`kernels.zonal.zonal_fold_masked` on device and :func:`host_pair_fold`
+(``np.add.at`` — sequential in row order, like XLA's CPU scatter) on
+host.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "CLIP_EPS",
+    "LEFT_PAD_CELL",
+    "RIGHT_PAD_CELL",
+    "clip_area_convex",
+    "emit_pairs",
+    "host_pair_fold",
+    "pair_areas",
+    "pair_count",
+    "pair_spans",
+]
+
+#: same half-plane epsilon as `core.tessellate._EPS` — device clips and
+#: the tessellation clipper must agree on what "on the edge" means
+CLIP_EPS = 1e-12
+
+#: pad sentinels for the sorted cell columns. Distinct per side so a pad
+#: row can never equi-join another pad row; both sort above every real
+#: cell id, so pads stay at the tail of the sorted table.
+LEFT_PAD_CELL = np.int64(2**62 - 1)
+RIGHT_PAD_CELL = np.int64(2**62 - 2)
+
+
+# ----------------------------------------------------- segment equi-join
+
+
+def pair_spans(lcells, rcells, n_left, xp=jnp):
+    """Per-left-row right-table span: ``(lo, cnt)`` with ``cnt[i]`` right
+    rows sharing cell ``lcells[i]`` starting at sorted right row
+    ``lo[i]``. Both cell columns must be sorted ascending with their pad
+    sentinels at the tail; rows at and past ``n_left`` count zero."""
+    lcells = xp.asarray(lcells)
+    rcells = xp.asarray(rcells)
+    lo = xp.searchsorted(rcells, lcells, side="left")
+    hi = xp.searchsorted(rcells, lcells, side="right")
+    valid = xp.arange(lcells.shape[0]) < n_left
+    cnt = xp.where(valid, hi - lo, 0)
+    return lo, cnt
+
+
+def pair_count(lcells, rcells, n_left, xp=jnp):
+    """Total candidate pair count of the sorted equi-join (exact, the
+    number `emit_pairs` would emit uncapped)."""
+    _, cnt = pair_spans(lcells, rcells, n_left, xp=xp)
+    return cnt.sum()
+
+
+def emit_pairs(lcells, rcells, n_left, emit_limit, pair_bucket: int,
+               xp=jnp):
+    """CSR cross-join emission against a static ``pair_bucket``.
+
+    Returns ``(li, ri, valid)`` — (Pb,) int32 sorted-table row indices
+    and the live-slot mask. Pair rank ``k`` resolves to its left row by
+    ``searchsorted(off, k, 'right') - 1`` over the exclusive span
+    offsets (zero-count rows are skipped by construction) and to its
+    right row by ``lo + (k - off)``. Emission order is left-row-major
+    over the cell-sorted table == cell-major — the exact stream order of
+    the host candidate generator, which is what makes the downstream
+    fold order reproducible. Slots at and past ``min(total,
+    emit_limit)`` are invalid (the caller books ``total - emitted`` as
+    OVERFLOW)."""
+    lo, cnt = pair_spans(lcells, rcells, n_left, xp=xp)
+    off = xp.cumsum(cnt) - cnt
+    total = cnt.sum()
+    nl = lcells.shape[0]
+    k = xp.arange(pair_bucket, dtype=off.dtype)
+    li = xp.clip(xp.searchsorted(off, k, side="right") - 1, 0, nl - 1)
+    ri = lo[li] + (k - off[li])
+    valid = k < xp.minimum(total, emit_limit)
+    li = xp.where(valid, li, 0)
+    ri = xp.where(valid, xp.clip(ri, 0, rcells.shape[0] - 1), 0)
+    return li.astype(xp.int32), ri.astype(xp.int32), valid
+
+
+# ------------------------------------------------------------- clip area
+
+
+def _gather_rows(arr, idx, xp):
+    """(P, V, 2) rows at per-row vertex index ``idx`` (P,) → (P, 2)."""
+    ix = xp.broadcast_to(
+        idx.astype(xp.int32)[:, None, None], (arr.shape[0], 1, 2)
+    )
+    return xp.take_along_axis(arr, ix, axis=1)[:, 0]
+
+
+def _scatter_rows(buf, pos, vals, width: int, xp):
+    """Host-side scatter of ``vals`` (P, W, 2) to ``buf[row,
+    pos[row, j]]``; slots with ``pos == width`` are dropped. Targets are
+    disjoint by construction (exclusive-cumsum positions), so the
+    scatter has no ordering dependence. The device lane packs through
+    :func:`_pack_rows` instead — XLA:CPU serializes ScatterOp."""
+    m = pos < width
+    rr, jj = np.nonzero(m)
+    buf[rr, pos[rr, jj]] = vals[rr, jj]
+    return buf
+
+
+def _pack_rows(cur, inter, emit0, emit1, base, new_len, jdx):
+    """Device-side twin of the two-scatter pack: left-pack each row's
+    emitted vertices (``cur[j]`` where ``emit0``, then ``inter[j]``
+    where ``emit1``, in slot order) by INVERTING the CSR placement —
+    each output slot binary-searches its source slot in the exclusive
+    offsets (``vmap``ed ``searchsorted``, all gathers, no ScatterOp)
+    and SELECTS its vertex verbatim. No arithmetic touches the payload,
+    so the packing is bit-exact (signed zeros survive) against the host
+    scatter twin."""
+    import jax
+
+    src = jax.vmap(
+        lambda b: jnp.searchsorted(b, jdx[0], side="right")
+    )(base)
+    j = jnp.clip(src - 1, 0, base.shape[1] - 1).astype(jnp.int32)
+    local = jdx - jnp.take_along_axis(base, j, axis=1)
+    use_cur = jnp.take_along_axis(emit0, j, axis=1) & (local == 0)
+    got_cur = jnp.take_along_axis(cur, j[:, :, None], axis=1)
+    got_int = jnp.take_along_axis(inter, j[:, :, None], axis=1)
+    val = jnp.where(use_cur[:, :, None], got_cur, got_int)
+    live = jdx < new_len[:, None]
+    return jnp.where(live[:, :, None], val, jnp.zeros_like(cur))
+
+
+def clip_area_convex(subj, slen, win, wlen, *, eps=CLIP_EPS, xp=jnp):
+    """Batched Sutherland–Hodgman clip AREA: signed area of
+    ``subj ∩ win`` per row.
+
+    ``subj`` (P, Vs, 2) / ``win`` (P, Vw, 2) CCW open rings, left-packed
+    to ``slen`` / ``wlen``; both convex (the table prep routes anything
+    else to the host lane). Returns ``(area, out_len, spill)`` — the
+    half-shoelace of the clipped ring, its vertex count, and a True
+    flag where a round wanted to emit more than the static ``Vs + Vw +
+    2`` buffer (impossible for convex inputs; a misclassified concave
+    ring trips it and is re-answered by the f64 host lane). Rows with
+    ``slen == 0`` report area 0.0 exactly.
+
+    Operation order mirrors `core.tessellate.clip_rings_convex_batch`
+    half-plane for half-plane; the shoelace is an unrolled static loop
+    so the f64 device result is bit-identical to the numpy twin
+    (``xp=np``) of this very function.
+    """
+    P, Vs, _ = subj.shape
+    Vw = win.shape[1]
+    W = Vs + Vw + 2
+    dt = subj.dtype
+    zero = xp.asarray(0.0, dt)
+    one = xp.asarray(1.0, dt)
+    if xp is jnp:
+        cur = jnp.zeros((P, W, 2), dt).at[:, :Vs].set(subj)
+    else:
+        cur = np.zeros((P, W, 2), dt)
+        cur[:, :Vs] = subj
+    clen = xp.asarray(slen).astype(xp.int32)
+    wlen = xp.asarray(wlen).astype(xp.int32)
+    spill = xp.zeros(P, bool)
+    jdx = xp.arange(W, dtype=xp.int32)[None, :]
+    for e in range(Vw):
+        active = (e < wlen) & (clen > 0)
+        a = _gather_rows(win, xp.minimum(e, wlen - 1), xp)
+        b = _gather_rows(win, xp.where(e + 1 < wlen, e + 1, 0), xp)
+        ax, ay = a[:, 0][:, None], a[:, 1][:, None]
+        dx = (b[:, 0] - a[:, 0])[:, None]
+        dy = (b[:, 1] - a[:, 1])[:, None]
+        s_cur = dx * (cur[:, :, 1] - ay) - dy * (cur[:, :, 0] - ax)
+        nxt = xp.where(jdx + 1 < clen[:, None], jdx + 1, 0)
+        nxt_xy = xp.take_along_axis(
+            cur, xp.broadcast_to(nxt[:, :, None], (P, W, 2)), axis=1
+        )
+        s_nxt = xp.take_along_axis(s_cur, nxt, axis=1)
+        valid = jdx < clen[:, None]
+        inside_cur = s_cur >= -eps
+        inside_nxt = s_nxt >= -eps
+        denom = s_cur - s_nxt
+        denom = xp.where(xp.abs(denom) < eps, one, denom)
+        t = xp.clip(s_cur / denom, zero, one)[:, :, None]
+        inter = cur + t * (nxt_xy - cur)
+        emit0 = valid & inside_cur & active[:, None]
+        emit1 = valid & (inside_cur != inside_nxt) & active[:, None]
+        cnt = emit0.astype(xp.int32) + emit1.astype(xp.int32)
+        base = xp.cumsum(cnt, axis=1) - cnt
+        new_len = cnt.sum(axis=1)
+        spill = spill | (active & (new_len > W))
+        if xp is jnp:
+            buf = _pack_rows(
+                cur, inter, emit0, emit1, base, new_len, jdx
+            )
+        else:
+            buf = xp.zeros((P, W, 2), dt)
+            buf = _scatter_rows(
+                buf, xp.where(emit0, base, W), cur, W, xp
+            )
+            buf = _scatter_rows(
+                buf, xp.where(emit1, base + emit0.astype(xp.int32), W),
+                inter, W, xp,
+            )
+        cur = xp.where(active[:, None, None], buf, cur)
+        clen = xp.where(active, xp.minimum(new_len, W), clen)
+    # unrolled shoelace: a fixed-order add chain on both backends
+    acc = xp.zeros(P, dt)
+    for j in range(W):
+        nj = xp.where(j + 1 < clen, j + 1, 0)
+        nxy = _gather_rows(cur, nj, xp)
+        contrib = cur[:, j, 0] * nxy[:, 1] - nxy[:, 0] * cur[:, j, 1]
+        acc = acc + xp.where(j < clen, contrib, zero)
+    area = xp.asarray(0.5, dt) * acc
+    return area, clen, spill
+
+
+# ------------------------------------------------------ per-pair measure
+
+
+def pair_areas(
+    lcore, rcore, lok, rok,
+    lverts, lvlen, rverts, rvlen,
+    larea, rarea, lcell_area,
+    band, *, eps=CLIP_EPS, xp=jnp,
+):
+    """Per-candidate intersection area with the host-lane routing flag.
+
+    Chips are clipped to their cell, so within a shared cell the pair
+    kinds collapse (``core ∩ X = X``):
+
+    - core × core   → the cell's area (precomputed f64 table);
+    - core × border → the border chip's area (precomputed f64 table);
+    - border × border, both device-clippable (single convex ring within
+      the vertex pad) → :func:`clip_area_convex`;
+    - anything else (multi-ring, holed, concave, over-pad) → area 0.0
+      here and ``host_needed`` True — the f64 host lane recomputes the
+      WHOLE geometry pair, in stream order, exactly as the oracle does.
+
+    ``band`` is the epsilon recheck threshold in area units
+    (``EDGE_BAND_K · eps(dtype) · scale²``): a clipped area whose
+    magnitude falls inside the band (shared edges, slivers, near-
+    degenerate contact) is also flagged for the f64 recheck, so the f32
+    device lane never decides a contact case. Returns ``(area,
+    host_needed)``.
+    """
+    bb = ~lcore & ~rcore
+    ok2 = bb & lok & rok
+    area2, _, spill = clip_area_convex(
+        lverts, xp.where(ok2, lvlen, 0), rverts, rvlen, eps=eps, xp=xp,
+    )
+    zero = xp.asarray(0.0, area2.dtype)
+    area = xp.where(
+        lcore & rcore, lcell_area,
+        xp.where(
+            lcore & ~rcore, rarea,
+            xp.where(~lcore & rcore, larea,
+                     xp.where(ok2, area2, zero)),
+        ),
+    )
+    near = ok2 & (xp.abs(area2) < band)
+    host_needed = (bb & ~(lok & rok)) | spill | near
+    area = xp.where(host_needed, zero, area)
+    return area, host_needed
+
+
+def host_pair_fold(values, valid, seg, num_segments: int,
+                   acc_dtype=np.float64):
+    """Sequential-order host fold of per-candidate values into per-pair
+    (count, sum) — the ``np.add.at`` twin of
+    `kernels.zonal.zonal_fold_masked`'s count/sum lanes: same overflow
+    bucket for masked rows, same accumulator dtype, same row-order
+    accumulation (XLA's CPU scatter applies updates sequentially, and so
+    does ``np.add.at``)."""
+    g = int(num_segments)
+    dt = np.dtype(acc_dtype)
+    seg = np.asarray(seg, np.int64)
+    valid = np.asarray(valid, bool) & (seg >= 0)
+    segc = np.where(valid, seg, g)
+    vals = np.where(valid, np.asarray(values, dt), dt.type(0))
+    s = np.zeros(g + 1, dt)
+    c = np.zeros(g + 1, np.int64)
+    np.add.at(s, segc, vals)
+    np.add.at(c, segc, valid.astype(np.int64))
+    return c[:g], s[:g]

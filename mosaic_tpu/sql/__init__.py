@@ -1,7 +1,16 @@
 """High-level services (reference analog: `src/main/scala/.../sql/`)."""
 
 from .join import ChipIndex, build_chip_index, pip_join, pip_join_points
-from .overlay import intersects_join, overlay_join
+from .overlay import (
+    OverlayMeasures,
+    OverlayPrep,
+    candidate_pairs,
+    intersects_join,
+    overlay_join,
+    overlay_measures,
+    prepare_overlay,
+    warmup_overlay,
+)
 from .raster_stream import RasterScanResult, RasterStream
 from .stream import (
     StreamJoin,
@@ -14,17 +23,23 @@ from .stream import (
 
 __all__ = [
     "ChipIndex",
+    "OverlayMeasures",
+    "OverlayPrep",
     "RasterScanResult",
     "RasterStream",
     "StreamJoin",
     "StreamResult",
     "build_chip_index",
+    "candidate_pairs",
     "generator_rate",
     "hbm_peak",
     "intersects_join",
     "overlay_join",
+    "overlay_measures",
     "pip_join",
     "pip_join_points",
+    "prepare_overlay",
     "ring_from_generator",
     "ring_from_host",
+    "warmup_overlay",
 ]

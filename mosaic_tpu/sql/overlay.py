@@ -1,30 +1,111 @@
-"""Polygon-polygon ST_Intersects overlay join (cell-indexed).
+"""Polygon-polygon overlay join: device candidates + fused overlap measures.
 
 Reference analog: the BNG overlay workload
 (`notebooks/examples/python/BritishNationalGrid.py`) — both polygon tables
 are tessellated into grid chips, the equi-join on cell id produces candidate
-pairs, and the exact `ST_Intersects` predicate runs only on pairs whose
-chips are both border chips (a core chip covers its whole cell, so any
-other geometry touching that cell intersects it by construction — the
-chip-table shortcut the reference's `is_core || st_intersects` predicate
-expresses).
+pairs, and the exact work runs only on pairs whose chips are both border
+chips (a core chip covers its whole cell, so any other geometry touching
+that cell intersects it by construction — the chip-table shortcut the
+reference's `is_core || st_intersects` predicate expresses).
 
-TPU-native shape: candidate generation is host columnar set algebra
-(sort + group join on int64 cell ids); the surviving exact predicate runs
-as one batched device `st_intersects` over the candidate chip pairs.
+Two lanes share one contract:
+
+- **Device lane** (:func:`overlay_measures`): both chip tables are sorted
+  by int64 cell id once (:func:`prepare_overlay`, amortized like the chip
+  index build), candidate generation runs on device as a sorted segment
+  equi-join (`kernels.overlay.pair_count` / `emit_pairs`) against a static
+  pair bucket, and the overlap measures — per-pair intersection area via
+  batched Sutherland–Hodgman clip, folded per geometry pair, with an
+  `expr/` pair tree evaluated over the folded tables — run as ONE fused
+  program per ``(tree-hash, buckets, index, mesh)`` signature through
+  `DispatchCore` (compile cache, warmup tripwire, watchdog/retry,
+  ``mesh=`` sharding, graceful degradation). Near-degenerate clip areas
+  (inside the ``EDGE_BAND_K·eps(acc)·scale²`` band), non-convex windows,
+  multi-ring/over-pad chips and spills are re-answered by the f64 host
+  lane per WHOLE geometry pair, so the accelerated dtype never decides a
+  contact case.
+- **Host lane** (`expr.host_oracle.host_overlay_measures`): the numpy twin
+  of the same kernels (``xp=np``) — the pure-f64 oracle the device lane
+  must match bitwise under x64, and the degradation target when the
+  device path fails past its retry budget.
+
+Caps are full-bucket and structural: when the candidate count exceeds
+``pair_cap`` (or the top pair bucket), the emission truncates and the
+result carries an OVERFLOW(-2) pair row — never a silent wrong answer,
+never an escalation.
+
+The boolean `ST_Intersects` join (:func:`intersects_join`) keeps its host
+columnar candidate generator, now deduplicated by geometry pair
+(core-beats-border precedence) so a pair sharing N cells is emitted once.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.index.base import IndexSystem
-from ..core.tessellate import ChipTable, tessellate
-from ..core.types import PackedGeometry
+from ..core.tessellate import ChipTable, _dedupe_boundaries_batch, tessellate
+from ..core.types import GeometryType, PackedGeometry
 from ..dispatch import core as _dispatch
+from ..kernels import overlay as _k
 from ..obs import trace as _trace
 from ..runtime import telemetry as _telemetry
 from ..runtime.errors import DegradedResult
+from .join import EDGE_BAND_K, OVERFLOW
+
+__all__ = [
+    "MAX_CHIP_VERTS",
+    "OverlayMeasures",
+    "OverlayPrep",
+    "OverlaySide",
+    "candidate_pairs",
+    "chip_candidate_rows",
+    "intersects_join",
+    "overlay_join",
+    "overlay_measures",
+    "pair_glue",
+    "pair_plan",
+    "prepare_overlay",
+    "warmup_overlay",
+]
+
+#: vertex pad ceiling for device-clippable chips — a border chip whose
+#: outer ring needs more vertices is routed to the f64 host lane (the
+#: pad enters the program signature, so it must stay small and stable)
+MAX_CHIP_VERTS = 32
+
+#: candidate-pair bucket ladder: min 8 so tiny caps exercise OVERFLOW
+#: semantics without a dedicated program population, top bucket 4M pairs
+PAIR_LADDER = _dispatch.BucketLadder(min_bucket=8, max_bucket=1 << 22)
+
+#: sorted side-table ladder (chip rows) and geometry-pair segment ladder
+TABLE_LADDER = _dispatch.BucketLadder(min_bucket=64, max_bucket=1 << 21)
+SEG_LADDER = _dispatch.BucketLadder(min_bucket=64, max_bucket=1 << 21)
+
+
+def _acc_name() -> str:
+    """Accelerated fold dtype — f64 under x64 (the CPU oracle contract),
+    f32 on accelerators without it (the epsilon band covers the gap)."""
+    return "float64" if jax.config.jax_enable_x64 else "float32"
+
+
+def pair_plan(total: int, pair_cap: int | None = None):
+    """``(Pb, emit_limit, overflow)`` for a candidate count — full-bucket
+    cap semantics: emission truncates at ``min(total, pair_cap, top
+    bucket)`` and the remainder is booked as structural OVERFLOW."""
+    total = int(total)
+    cap = PAIR_LADDER.max_bucket if pair_cap is None else int(pair_cap)
+    emit_limit = min(total, cap, PAIR_LADDER.max_bucket)
+    Pb = PAIR_LADDER.bucket_for(max(emit_limit, 1))
+    return Pb, emit_limit, total - emit_limit
+
+
+# ------------------------------------------------ host candidate columns
 
 
 def _group_spans(cells_sorted: np.ndarray):
@@ -41,18 +122,83 @@ def _group_spans(cells_sorted: np.ndarray):
     return cells_sorted[start], start, stop
 
 
+def chip_candidate_rows(
+    left: ChipTable, right: ChipTable
+) -> tuple[np.ndarray, np.ndarray]:
+    """Raw chip-row candidate pairs sharing a cell (host columnar set
+    algebra). A geometry pair sharing N cells appears N times here — the
+    per-shared-cell stream the area fold consumes; use
+    :func:`candidate_pairs` for the deduplicated geometry-pair view."""
+    lc = np.asarray(left.cell_id)
+    rc = np.asarray(right.cell_id)
+    lo = np.argsort(lc, kind="stable")
+    ro = np.argsort(rc, kind="stable")
+    lu, ls, le_ = _group_spans(lc[lo])
+    ru, rs, re_ = _group_spans(rc[ro])
+    common, li, ri = np.intersect1d(lu, ru, return_indices=True)
+    if not common.shape[0]:
+        z = np.zeros(0, np.int64)
+        return z, z
+    # vectorized per-cell cross join: left rows repeat by the right
+    # group size, right rows tile within each (cell, left-row) block
+    ln = le_[li] - ls[li]  # left group size per common cell
+    rn = re_[ri] - rs[ri]  # right group size per common cell
+    pair_n = ln * rn
+    cell_of = np.repeat(np.arange(common.shape[0]), pair_n)
+    off = np.concatenate([[0], np.cumsum(pair_n)])[:-1]
+    k = np.arange(int(pair_n.sum())) - off[cell_of]  # rank within cell
+    lrows = lo[ls[li][cell_of] + k // rn[cell_of]]
+    rrows = ro[rs[ri][cell_of] + k % rn[cell_of]]
+    return lrows, rrows
+
+
+def _dedup_pairs(left: ChipTable, right: ChipTable,
+                 lrows: np.ndarray, rrows: np.ndarray):
+    """Chip-row candidates → unique geometry pairs with core-beats-border
+    precedence: ``sure[p]`` is True when ANY shared cell of pair ``p``
+    has a core chip on either side (intersection certain there, no
+    predicate needed anywhere for the pair)."""
+    lgeom = np.asarray(left.geom_id)[lrows]
+    rgeom = np.asarray(right.geom_id)[rrows]
+    either = (
+        np.asarray(left.is_core)[lrows] | np.asarray(right.is_core)[rrows]
+    )
+    uniq, pair_id = np.unique(
+        np.stack([lgeom, rgeom], axis=-1), axis=0, return_inverse=True
+    )
+    sure = np.zeros(uniq.shape[0], bool)
+    np.logical_or.at(sure, pair_id, either)
+    return uniq, pair_id, either, sure
+
+
+def _candidate_stats(span, sure: np.ndarray) -> None:
+    """Record the profileable candidate statistics (deduplicated
+    geometry-pair counts) on the span and the telemetry stream."""
+    n = int(sure.shape[0])
+    sure_fraction = float(sure.sum()) / max(1, n)
+    stats = {
+        "candidates": n,
+        "sure_fraction": round(sure_fraction, 6),
+        "border_fraction": round(1.0 - sure_fraction, 6),
+    }
+    span.set(**stats)
+    _telemetry.record("overlay_candidates", **stats)
+
+
 def candidate_pairs(
     left: ChipTable, right: ChipTable
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Chip-row candidate pairs sharing a cell.
+    """Deduplicated geometry-pair candidates sharing at least one cell.
 
-    Returns (lrows, rrows, sure): chip-row index pairs, and ``sure`` True
-    where at least one side's chip is core (intersection certain).
+    Returns ``(lgeom, rgeom, sure)`` — one row per (left geometry, right
+    geometry) pair regardless of how many cells the pair shares, with
+    ``sure`` True where some shared cell has a core chip on either side
+    (core beats border: the pair is accepted without a predicate).
 
     Emits an ``overlay.candidates`` span (and matching
     ``overlay_candidates`` telemetry) with the candidate count, the
     sure-fraction (pairs accepted without a predicate), and the
-    border-pair fraction (pairs that will pay the exact predicate) — the
+    border-pair fraction (pairs that will pay exact work) — the
     statistics that make overlay workloads profileable like the point
     frontends.
     """
@@ -61,44 +207,14 @@ def candidate_pairs(
         left_chips=int(np.asarray(left.cell_id).shape[0]),
         right_chips=int(np.asarray(right.cell_id).shape[0]),
     ) as span:
-        lc = np.asarray(left.cell_id)
-        rc = np.asarray(right.cell_id)
-        lo = np.argsort(lc, kind="stable")
-        ro = np.argsort(rc, kind="stable")
-        lu, ls, le_ = _group_spans(lc[lo])
-        ru, rs, re_ = _group_spans(rc[ro])
-        common, li, ri = np.intersect1d(lu, ru, return_indices=True)
-        if not common.shape[0]:
+        lrows, rrows = chip_candidate_rows(left, right)
+        if not lrows.shape[0]:
+            _candidate_stats(span, np.zeros(0, bool))
             z = np.zeros(0, np.int64)
-            span.set(candidates=0, sure_fraction=0.0, border_fraction=0.0)
-            _telemetry.record(
-                "overlay_candidates", candidates=0,
-                sure_fraction=0.0, border_fraction=0.0,
-            )
             return z, z, np.zeros(0, bool)
-        # vectorized per-cell cross join: left rows repeat by the right
-        # group size, right rows tile within each (cell, left-row) block
-        ln = le_[li] - ls[li]  # left group size per common cell
-        rn = re_[ri] - rs[ri]  # right group size per common cell
-        pair_n = ln * rn
-        cell_of = np.repeat(np.arange(common.shape[0]), pair_n)
-        off = np.concatenate([[0], np.cumsum(pair_n)])[:-1]
-        k = np.arange(int(pair_n.sum())) - off[cell_of]  # rank within cell
-        lrows = lo[ls[li][cell_of] + k // rn[cell_of]]
-        rrows = ro[rs[ri][cell_of] + k % rn[cell_of]]
-        sure = (
-            np.asarray(left.is_core)[lrows] | np.asarray(right.is_core)[rrows]
-        )
-        n = int(sure.shape[0])
-        sure_fraction = float(sure.sum()) / max(1, n)
-        stats = {
-            "candidates": n,
-            "sure_fraction": round(sure_fraction, 6),
-            "border_fraction": round(1.0 - sure_fraction, 6),
-        }
-        span.set(**stats)
-        _telemetry.record("overlay_candidates", **stats)
-        return lrows, rrows, sure
+        uniq, _, _, sure = _dedup_pairs(left, right, lrows, rrows)
+        _candidate_stats(span, sure)
+        return uniq[:, 0], uniq[:, 1], sure
 
 
 def intersects_join(
@@ -137,23 +253,23 @@ def intersects_join(
         if right_chips is not None
         else tessellate(right, index_system, resolution)
     )
-    lrows, rrows, sure = candidate_pairs(lt, rt)
-    if not lrows.shape[0]:
-        return np.zeros((0, 2), np.int64)
-
-    lgeom = np.asarray(lt.geom_id)[lrows]
-    rgeom = np.asarray(rt.geom_id)[rrows]
-    hit = sure.copy()
+    with _trace.span(
+        "overlay.candidates",
+        left_chips=int(np.asarray(lt.cell_id).shape[0]),
+        right_chips=int(np.asarray(rt.cell_id).shape[0]),
+    ) as span:
+        lrows, rrows = chip_candidate_rows(lt, rt)
+        if not lrows.shape[0]:
+            _candidate_stats(span, np.zeros(0, bool))
+            return np.zeros((0, 2), np.int64)
+        uniq_pairs, pair_id, either, psure = _dedup_pairs(
+            lt, rt, lrows, rrows
+        )
+        _candidate_stats(span, psure)
+    hit = either.copy()
     # a geometry pair already accepted via a core chip in ANY shared cell
     # needs no predicate for its remaining border-border candidates
-    # (pair identity via unique-inverse on the 2-column array — exact for
-    # any row-id width, no packed-key collisions)
-    uniq_pairs, pair_id = np.unique(
-        np.stack([lgeom, rgeom], axis=-1), axis=0, return_inverse=True
-    )
-    decided = np.zeros(uniq_pairs.shape[0], bool)
-    decided[pair_id[sure]] = True
-    need = np.nonzero(~sure & ~decided[pair_id])[0]
+    need = np.nonzero(~either & ~psure[pair_id])[0]
     degraded: DegradedResult | None = None
     if need.shape[0]:
         from ..functions.geometry import st_intersects
@@ -194,3 +310,578 @@ def intersects_join(
 #: the managed overlay entry point under its workload name (the BNG
 #: overlay notebook's join) — same callable, resilience included
 overlay_join = intersects_join
+
+
+# ------------------------------------------------------- device-lane prep
+
+
+@dataclass(frozen=True)
+class OverlaySide:
+    """One cell-sorted, bucket-padded side table of an overlay prep.
+
+    All per-row arrays are in sorted-by-cell order, padded to ``bucket``
+    rows (pad cells carry a per-side sentinel that sorts above every
+    real cell and can never equi-join the other side's sentinel).
+    ``rows`` maps sorted row → original chip row for the host override
+    lane; ``geom_area`` is indexed by ORIGINAL geometry id.
+    """
+
+    table: ChipTable
+    n: int
+    bucket: int
+    cells: np.ndarray      # (Lb,) i64 sorted ascending, sentinel tail
+    geom: np.ndarray       # (Lb,) i64 geometry id, -1 pad
+    core: np.ndarray       # (Lb,) bool
+    ok_subj: np.ndarray    # (Lb,) bool device-clippable as clip SUBJECT
+    ok_win: np.ndarray     # (Lb,) bool device-clippable as clip WINDOW
+    verts: np.ndarray      # (Lb, V, 2) f64 shifted CCW open rings
+    vlen: np.ndarray       # (Lb,) i32 left-packed vertex counts
+    chip_area: np.ndarray  # (Lb,) f64 |chip| (core rows: the cell area)
+    cell_area: np.ndarray  # (Lb,) f64 area of the row's cell
+    rows: np.ndarray       # (n,) i64 sorted row -> original chip row
+    geom_area: np.ndarray  # (G,) f64 |geometry| (shifted frame)
+
+
+@dataclass(frozen=True)
+class OverlayPrep:
+    """Amortized overlay prep: both sorted side tables plus the shared
+    coordinate frame (``shift``/``scale``), the accelerated fold dtype,
+    the epsilon-band threshold in area units and the vertex pad — every
+    static piece of the fused program's signature."""
+
+    left: OverlaySide
+    right: OverlaySide
+    shift: np.ndarray
+    scale: float
+    index_system: IndexSystem
+    resolution: int
+    acc_name: str
+    band: float
+    vpad: int
+
+
+def _csr_geom_areas(col: PackedGeometry, shift: np.ndarray) -> np.ndarray:
+    """(G,) f64 polygon areas (|shells| − |holes|), vectorized over the
+    CSR offsets — the columnar twin of `core.geometry.oracle.area`
+    (shell = first ring of its part, open rings, wraparound shoelace).
+    Non-polygon rows report 0.0; coordinates are shifted first so the
+    table is computed in the same frame the clip kernels run in."""
+    G = len(col)
+    out = np.zeros(G, np.float64)
+    nv = int(np.asarray(col.xy).shape[0])
+    if not G or not nv:
+        return out
+    x = np.asarray(col.xy[:, 0], np.float64) - float(shift[0])
+    y = np.asarray(col.xy[:, 1], np.float64) - float(shift[1])
+    ro = np.asarray(col.ring_offsets, np.int64)
+    po = np.asarray(col.part_offsets, np.int64)
+    go = np.asarray(col.geom_offsets, np.int64)
+    R = ro.shape[0] - 1
+    ring_of = np.repeat(np.arange(R), np.diff(ro))
+    nxt = np.arange(nv) + 1
+    nxt = np.where(nxt == ro[1:][ring_of], ro[:-1][ring_of], nxt)
+    ring_area = np.zeros(R, np.float64)
+    np.add.at(ring_area, ring_of, x * y[nxt] - x[nxt] * y)
+    ring_area *= 0.5
+    part_of_ring = np.repeat(np.arange(po.shape[0] - 1), np.diff(po))
+    is_shell = np.arange(R) == po[:-1][part_of_ring]
+    signed = np.where(is_shell, np.abs(ring_area), -np.abs(ring_area))
+    geom_of_part = np.repeat(np.arange(G), np.diff(go))
+    np.add.at(out, geom_of_part[part_of_ring], signed)
+    gt = np.asarray(col.geom_type, np.int64)
+    base = np.where(gt > 3, gt - 3, gt)
+    return np.where(base == int(GeometryType.POLYGON), out, 0.0)
+
+
+def _masked_shoelace(verts: np.ndarray, vlen: np.ndarray) -> np.ndarray:
+    """(N,) f64 signed shoelace areas of left-packed open rings."""
+    x, y = verts[:, :, 0], verts[:, :, 1]
+    j = np.arange(verts.shape[1])[None, :]
+    nxt = np.where(j + 1 < vlen[:, None], j + 1, 0)
+    xn = np.take_along_axis(x, nxt, axis=1)
+    yn = np.take_along_axis(y, nxt, axis=1)
+    contrib = np.where(j < vlen[:, None], x * yn - xn * y, 0.0)
+    return 0.5 * contrib.sum(axis=1)
+
+
+def _chip_analysis(table: ChipTable):
+    """Per-chip-row CSR facts: ``(simple, r0s, r0l)`` — device-clippable
+    shape class (single-part single-ring polygon with a stored geometry)
+    plus its outer ring span."""
+    ch = table.chips
+    C = len(ch)
+    if not C:
+        z = np.zeros(0, np.int64)
+        return np.zeros(0, bool), z, z
+    has = np.asarray(table.has_geom, bool)
+    go = np.asarray(ch.geom_offsets, np.int64)
+    po = np.asarray(ch.part_offsets, np.int64)
+    ro = np.asarray(ch.ring_offsets, np.int64)
+    gt = np.asarray(ch.geom_type, np.int64)
+    nparts = np.diff(go)
+    nrings = po[go[1:]] - po[go[:-1]]
+    fr = np.minimum(po[go[:-1]], max(ro.shape[0] - 2, 0))
+    r0s = ro[fr]
+    r0l = ro[fr + 1] - r0s
+    base = np.where(gt > 3, gt - 3, gt)
+    simple = (
+        has
+        & (base == int(GeometryType.POLYGON))
+        & (nparts == 1)
+        & (nrings == 1)
+        & (r0l >= 3)
+    )
+    return simple, r0s, r0l
+
+
+def _side_verts(table: ChipTable, simple, r0s, r0l, V: int,
+                shift: np.ndarray, scale: float):
+    """(eligible, ok_win, verts, vlen) in original chip-row order —
+    left-packed CCW shifted outer rings padded by repeating the last
+    vertex, plus the convex-window eligibility flag."""
+    C = len(table.chips)
+    if not C:
+        return (
+            np.zeros(0, bool), np.zeros(0, bool),
+            np.zeros((0, V, 2), np.float64), np.zeros(0, np.int32),
+        )
+    eligible = simple & (r0l <= V)
+    xy = np.asarray(table.chips.xy, np.float64)
+    safe_len = np.maximum(r0l, 1)
+    idx = r0s[:, None] + np.minimum(np.arange(V)[None, :],
+                                    safe_len[:, None] - 1)
+    idx = np.clip(idx, 0, max(xy.shape[0] - 1, 0))
+    verts = xy[idx]
+    vlen = np.where(eligible, r0l, 0).astype(np.int32)
+    # orient CCW (reverse the valid prefix where the ring is CW)
+    sa = _masked_shoelace(verts, vlen)
+    j = np.arange(V)[None, :]
+    rev = np.where(j < vlen[:, None],
+                   np.maximum(vlen[:, None] - 1 - j, 0), j)
+    flipped = np.take_along_axis(verts, rev[:, :, None], axis=1)
+    verts = np.where((sa < 0)[:, None, None], flipped, verts)
+    verts = verts - np.asarray(shift, np.float64)[None, None, :]
+    # convex-window test on the oriented, shifted ring: every pair of
+    # consecutive edges turns left (cross ≥ -tol), wraparound included
+    nxt = np.where(j + 1 < vlen[:, None], j + 1, 0)
+    nxy = np.take_along_axis(verts, nxt[:, :, None], axis=1)
+    e = nxy - verts
+    en = np.take_along_axis(e, nxt[:, :, None], axis=1)
+    cross = e[:, :, 0] * en[:, :, 1] - e[:, :, 1] * en[:, :, 0]
+    tol = _k.CLIP_EPS * scale * scale
+    convex = np.all(
+        np.where(j < vlen[:, None], cross, 0.0) >= -tol, axis=1
+    )
+    return eligible, eligible & convex, verts, vlen
+
+
+def prepare_overlay(
+    left_chips: ChipTable,
+    right_chips: ChipTable,
+    left: PackedGeometry,
+    right: PackedGeometry,
+    index_system: IndexSystem,
+    resolution: int,
+) -> OverlayPrep:
+    """Build the amortized device-lane prep for an overlay table pair.
+
+    One host pass per table pair: sort both chip tables by cell id, pad
+    to ladder buckets with per-side sentinels, precompute the f64 area
+    tables (chip, cell, whole-geometry — all in a shared shifted frame
+    centered on the data so the f32 lane keeps maximal mantissa), pack
+    the device-clippable outer rings to the vertex pad, and derive the
+    epsilon-band threshold. Everything here is reused across measures,
+    caps and meshes — only the fused program varies per signature.
+    """
+    with _trace.span(
+        "overlay.prepare",
+        left_chips=int(np.asarray(left_chips.cell_id).shape[0]),
+        right_chips=int(np.asarray(right_chips.cell_id).shape[0]),
+    ):
+        lcells_raw = np.asarray(left_chips.cell_id, np.int64)
+        rcells_raw = np.asarray(right_chips.cell_id, np.int64)
+        ucells = np.unique(np.concatenate([lcells_raw, rcells_raw]))
+        if ucells.shape[0]:
+            bnds = np.asarray(
+                index_system.cell_boundary(ucells), np.float64
+            )
+        else:
+            bnds = np.zeros((0, 4, 2), np.float64)
+        lxy = np.asarray(left_chips.chips.xy, np.float64).reshape(-1, 2)
+        rxy = np.asarray(right_chips.chips.xy, np.float64).reshape(-1, 2)
+        allxy = np.concatenate([lxy, rxy, bnds.reshape(-1, 2)], axis=0)
+        if allxy.shape[0]:
+            lo, hi = allxy.min(axis=0), allxy.max(axis=0)
+            shift = 0.5 * (lo + hi)
+            scale = float(max(1.0, float(np.max(np.abs(allxy - shift)))))
+        else:
+            shift = np.zeros(2, np.float64)
+            scale = 1.0
+        cell_polys, klen = _dedupe_boundaries_batch(bnds)
+        ucell_area = np.abs(_masked_shoelace(
+            cell_polys - shift[None, None, :], klen.astype(np.int64)
+        ))
+
+        lsimple, lr0s, lr0l = _chip_analysis(left_chips)
+        rsimple, rr0s, rr0l = _chip_analysis(right_chips)
+
+        def _border_max(table, simple, r0l):
+            m = simple & ~np.asarray(table.is_core, bool)
+            return int(r0l[m].max()) if m.any() else 0
+
+        V = int(min(MAX_CHIP_VERTS, max(
+            4,
+            _border_max(left_chips, lsimple, lr0l),
+            _border_max(right_chips, rsimple, rr0l),
+        )))
+
+        acc = _acc_name()
+        band = (
+            EDGE_BAND_K * float(np.finfo(np.dtype(acc)).eps)
+            * scale * scale
+        )
+
+        def _side(table, col, cells_raw, simple, r0s, r0l, pad_cell):
+            n = int(cells_raw.shape[0])
+            order = np.argsort(cells_raw, kind="stable")
+            Lb = TABLE_LADDER.bucket_for(max(n, 1))
+            elig, ok_win, verts, vlen = _side_verts(
+                table, simple, r0s, r0l, V, shift, scale
+            )
+            chip_area = _csr_geom_areas(table.chips, shift)
+            pos = np.searchsorted(ucells, cells_raw)
+            row_cell_area = (
+                ucell_area[pos] if n else np.zeros(0, np.float64)
+            )
+            core = np.asarray(table.is_core, bool)
+            # a core chip covers its cell exactly — use the cell table so
+            # the core branches and the area tables agree bit-for-bit
+            chip_area = np.where(core, row_cell_area, chip_area)
+
+            def pad(a, fill=0):
+                out = np.full((Lb,) + a.shape[1:], fill, a.dtype)
+                out[:n] = a[order]
+                return out
+
+            return OverlaySide(
+                table=table,
+                n=n,
+                bucket=Lb,
+                cells=pad(cells_raw, pad_cell),
+                geom=pad(np.asarray(table.geom_id, np.int64), -1),
+                core=pad(core),
+                ok_subj=pad(elig),
+                ok_win=pad(ok_win),
+                verts=pad(verts),
+                vlen=pad(vlen),
+                chip_area=pad(chip_area),
+                cell_area=pad(row_cell_area),
+                rows=order.astype(np.int64),
+                geom_area=_csr_geom_areas(col, shift),
+            )
+
+        return OverlayPrep(
+            left=_side(left_chips, left, lcells_raw, lsimple, lr0s,
+                       lr0l, _k.LEFT_PAD_CELL),
+            right=_side(right_chips, right, rcells_raw, rsimple, rr0s,
+                        rr0l, _k.RIGHT_PAD_CELL),
+            shift=np.asarray(shift, np.float64),
+            scale=scale,
+            index_system=index_system,
+            resolution=resolution,
+            acc_name=acc,
+            band=float(band),
+            vpad=V,
+        )
+
+
+def pair_glue(prep: OverlayPrep, li, ri, valid):
+    """Candidate stream → geometry-pair segments (host glue, shared by
+    the device lane and its numpy twin so both see identical segment
+    ids): ``(uniq (U, 2) i64, seg (Pb,) i32 with -1 for dead slots,
+    sure (U,), Sb, seg_larea (Sb,) f64, seg_rarea (Sb,) f64)``."""
+    L, R = prep.left, prep.right
+    li = np.asarray(li)
+    ri = np.asarray(ri)
+    valid = np.asarray(valid, bool)
+    lg = L.geom[li]
+    rg = R.geom[ri]
+    valid = valid & (lg >= 0) & (rg >= 0)
+    seg = np.full(li.shape[0], -1, np.int32)
+    if valid.any():
+        uniq, inv = np.unique(
+            np.stack([lg[valid], rg[valid]], axis=-1),
+            axis=0, return_inverse=True,
+        )
+        seg[valid] = inv.astype(np.int32)
+    else:
+        uniq = np.zeros((0, 2), np.int64)
+    U = uniq.shape[0]
+    sure = np.zeros(U, bool)
+    either = L.core[li] | R.core[ri]
+    if valid.any():
+        np.logical_or.at(sure, seg[valid], either[valid])
+    Sb = SEG_LADDER.bucket_for(max(U, 1))
+    seg_larea = np.zeros(Sb, np.float64)
+    seg_rarea = np.zeros(Sb, np.float64)
+    if U:
+        seg_larea[:U] = L.geom_area[uniq[:, 0]]
+        seg_rarea[:U] = R.geom_area[uniq[:, 1]]
+    return uniq, seg, sure, Sb, seg_larea, seg_rarea
+
+
+# --------------------------------------------------- device-lane programs
+
+
+@_dispatch.bounded_cache("overlay_count_programs", 8)
+def _count_program():
+    return jax.jit(partial(_k.pair_count, xp=jnp))
+
+
+@_dispatch.bounded_cache("overlay_emit_programs", 32)
+def _emit_program(pair_bucket: int):
+    return jax.jit(
+        partial(_k.emit_pairs, pair_bucket=pair_bucket, xp=jnp)
+    )
+
+
+@dataclass(frozen=True)
+class OverlayMeasures:
+    """Fused overlay measure result — one row per unique geometry pair
+    sharing at least one cell (plus, when the candidate stream was
+    capped, a trailing ``(OVERFLOW, OVERFLOW)`` row with NaN measures:
+    structural truncation, never a silent wrong answer).
+
+    ``value`` is the evaluated pair tree (f64), ``valid`` its mask lane,
+    ``area`` the folded intersection area, ``sure`` the core-chip
+    certainty flag, ``host_overridden`` how many pairs the f64 host lane
+    re-answered (epsilon band / shape class), and ``lane`` which lane
+    produced the numbers (``degraded`` True when the device lane failed
+    past its retry budget and the host oracle answered instead)."""
+
+    pairs: np.ndarray
+    value: np.ndarray
+    valid: np.ndarray
+    area: np.ndarray
+    sure: np.ndarray
+    overflow: int
+    lane: str
+    host_overridden: int
+    degraded: bool = False
+    reason: str = ""
+
+
+def _package(out: dict, lane: str, degraded: bool = False,
+             reason: str = "") -> OverlayMeasures:
+    """Lane output dict → :class:`OverlayMeasures`, appending the
+    OVERFLOW(-2) row when the emission was capped."""
+    pairs = out["pairs"]
+    value = out["value"]
+    vmask = out["valid"]
+    area = out["area"]
+    sure = out["sure"]
+    overflow = int(out["overflow"])
+    if overflow > 0:
+        pairs = np.concatenate(
+            [pairs, np.asarray([[OVERFLOW, OVERFLOW]], np.int64)]
+        )
+        value = np.concatenate([value, [np.nan]])
+        area = np.concatenate([area, [np.nan]])
+        vmask = np.concatenate([vmask, [False]])
+        sure = np.concatenate([sure, [False]])
+    return OverlayMeasures(
+        pairs=pairs, value=value, valid=vmask, area=area, sure=sure,
+        overflow=overflow, lane=lane,
+        host_overridden=int(out["host_overridden"]),
+        degraded=degraded, reason=reason,
+    )
+
+
+def overlay_measures(
+    left: PackedGeometry,
+    right: PackedGeometry,
+    index_system: IndexSystem,
+    resolution: int,
+    value=None,
+    *,
+    left_chips: ChipTable | None = None,
+    right_chips: ChipTable | None = None,
+    prep: OverlayPrep | None = None,
+    pair_cap: int | None = None,
+    mesh=None,
+    lane: str = "device",
+) -> OverlayMeasures:
+    """Fused overlap measures per intersecting geometry pair.
+
+    ``value`` is an `expr/` PAIR tree over :func:`expr.ast.overlap_area`
+    / ``left_area`` / ``right_area`` (default: the raw intersection
+    area); ``st_intersection_area`` and ``st_overlap_fraction`` are the
+    canned frontends. Candidate generation runs on device as a sorted
+    segment equi-join over the prep's cell columns, the measures as ONE
+    fused program per ``(tree-hash, buckets, index, mesh)`` signature —
+    warm it with :func:`warmup_overlay` before `expr.compile.freeze`.
+
+    ``lane="host"`` routes to the pure-f64 numpy twin (the oracle); the
+    device lane degrades there automatically (result flagged) when the
+    device path fails past its retry budget. ``pair_cap`` bounds the
+    candidate emission — the excess is reported as an OVERFLOW(-2) row,
+    never silently dropped.
+    """
+    from ..expr import ast as _ast
+    from ..expr import compile as _compile
+    from ..expr.host_oracle import host_overlay_measures, splice_override
+
+    value = _ast.overlap_area() if value is None else value
+    _ast.validate_pair(value)
+    mesh = _dispatch.resolve_mesh(mesh)
+    if prep is None:
+        lt = (
+            left_chips
+            if left_chips is not None
+            else tessellate(left, index_system, resolution)
+        )
+        rt = (
+            right_chips
+            if right_chips is not None
+            else tessellate(right, index_system, resolution)
+        )
+        prep = prepare_overlay(
+            lt, rt, left, right, index_system, resolution
+        )
+    if lane == "host":
+        out = host_overlay_measures(prep, value, pair_cap=pair_cap)
+        return _package(out, lane="host")
+    if lane != "device":
+        raise ValueError(f"unknown overlay lane {lane!r}")
+
+    L, R = prep.left, prep.right
+    acc = np.dtype(prep.acc_name)
+    try:
+        with _trace.span(
+            "overlay.device_candidates",
+            left_chips=L.n, right_chips=R.n,
+        ) as span:
+            with _telemetry.timed("overlay_stage", stage="candidates"):
+
+                def device_candidates():
+                    total = int(
+                        _count_program()(L.cells, R.cells, L.n)
+                    )
+                    Pb, emit_limit, overflow = pair_plan(
+                        total, pair_cap
+                    )
+                    li, ri, valid = _emit_program(Pb)(
+                        L.cells, R.cells, L.n, emit_limit
+                    )
+                    return (
+                        np.asarray(li), np.asarray(ri),
+                        np.asarray(valid), total, Pb, emit_limit,
+                        overflow,
+                    )
+
+                li, ri, valid, total, Pb, emit_limit, overflow = (
+                    _dispatch.guarded_call(
+                        "overlay.device_candidates", device_candidates
+                    )
+                )
+                uniq, seg, sure, Sb, seg_l64, seg_r64 = pair_glue(
+                    prep, li, ri, valid
+                )
+            span.set(
+                raw_candidates=total, emitted=emit_limit,
+                overflow=overflow,
+            )
+            _candidate_stats(span, sure)
+
+        with _trace.span(
+            "overlay.measures", pairs=int(uniq.shape[0]),
+            candidates=total, mesh=_dispatch.mesh_key(mesh) is not None,
+        ) as span:
+            with _telemetry.timed("overlay_stage", stage="measures"):
+                sig = _compile.overlay_signature_of(
+                    value, L.bucket, R.bucket, Pb, Sb, prep.vpad,
+                    prep.acc_name, index_system, resolution, mesh,
+                )
+                prog = _compile.overlay_program(
+                    value, L.bucket, R.bucket, Pb, Sb, prep.vpad,
+                    prep.acc_name, mesh,
+                )
+                raw = _dispatch.guarded_call(
+                    "overlay.measures",
+                    _compile.run_tracked, sig, prog,
+                    li, ri, valid, seg,
+                    L.core, L.ok_subj,
+                    L.verts.astype(acc), L.vlen,
+                    L.chip_area.astype(acc), L.cell_area.astype(acc),
+                    R.core, R.ok_win,
+                    R.verts.astype(acc), R.vlen,
+                    R.chip_area.astype(acc),
+                    seg_l64.astype(acc), seg_r64.astype(acc),
+                    acc.type(prep.band),
+                )
+                val, vok, s, _cnt, host_needed = (
+                    np.asarray(x) for x in raw
+                )
+                val = val.astype(np.float64).copy()
+                vok = vok.astype(bool).copy()
+                area64 = s.astype(np.float64).copy()
+                val, vok, area64, overridden = splice_override(
+                    prep, value, li, ri, valid, seg,
+                    host_needed, seg_l64, seg_r64, val, vok, area64,
+                )
+            span.set(host_overridden=overridden)
+        U = uniq.shape[0]
+        return _package(
+            {
+                "pairs": uniq, "value": val[:U], "valid": vok[:U],
+                "area": area64[:U], "sure": sure,
+                "overflow": overflow, "host_overridden": overridden,
+            },
+            lane="device",
+        )
+    except Exception as e:  # lint: broad-except-ok (degradation seam: past the retry budget the f64 host oracle answers instead; the result is flagged, parity with every other DispatchCore frontend)
+        _telemetry.record(
+            "degraded", label="overlay.measures", error=repr(e)[:200]
+        )
+        out = host_overlay_measures(prep, value, pair_cap=pair_cap)
+        return _package(
+            out, lane="host", degraded=True,
+            reason=f"overlay.measures: {e!r}"[:300],
+        )
+
+
+def warmup_overlay(
+    left: PackedGeometry,
+    right: PackedGeometry,
+    index_system: IndexSystem,
+    resolution: int,
+    value=None,
+    *,
+    left_chips: ChipTable | None = None,
+    right_chips: ChipTable | None = None,
+    prep: OverlayPrep | None = None,
+    pair_cap: int | None = None,
+    mesh=None,
+) -> OverlayPrep:
+    """Execute the device overlay pipeline once so its signature joins
+    the warm set (`expr.compile.freeze` afterwards arms the cold-compile
+    tripwire) and return the prep for amortized reuse."""
+    if prep is None:
+        lt = (
+            left_chips
+            if left_chips is not None
+            else tessellate(left, index_system, resolution)
+        )
+        rt = (
+            right_chips
+            if right_chips is not None
+            else tessellate(right, index_system, resolution)
+        )
+        prep = prepare_overlay(
+            lt, rt, left, right, index_system, resolution
+        )
+    overlay_measures(
+        left, right, index_system, resolution, value,
+        prep=prep, pair_cap=pair_cap, mesh=mesh,
+    )
+    return prep

@@ -38,6 +38,13 @@ SPARSE_TILE_OCCUPANCY = 0.5
 #: candidate list grows
 OVERLAY_BORDER_SHARE = 0.5
 
+#: candidate-pair count above which the device overlay lane amortizes its
+#: fixed costs (prep transfer + one fused launch) over enough pairs to beat
+#: the host numpy twin — the OVERLAY_r17 bench lane crosses over well below
+#: this, so the threshold is conservative; below it the host oracle lane is
+#: both exact and cheaper
+OVERLAY_DEVICE_CANDIDATES = 4096
+
 
 @dataclasses.dataclass
 class TuningProfile:
@@ -56,6 +63,7 @@ class TuningProfile:
     stream_pipeline: "bool | None" = None
     raster_tile: "tuple | None" = None
     zonal_lane: "str | None" = None
+    overlay_lane: "str | None" = None
     rationale: list = dataclasses.field(default_factory=list)
     source: dict = dataclasses.field(default_factory=dict)
 
@@ -105,7 +113,13 @@ def load_priors(root: "str | Path | None" = None) -> dict:
         root = Path(__file__).resolve().parents[2]
     root = Path(root)
     priors: dict = {"artifacts": {}}
-    for pattern in ("TREND.json", "BENCH_*.json", "STREAM_*.json", "RASTER_*.json"):
+    for pattern in (
+        "TREND.json",
+        "BENCH_*.json",
+        "STREAM_*.json",
+        "RASTER_*.json",
+        "OVERLAY_*.json",
+    ):
         for path in sorted(root.glob(pattern)):
             try:
                 priors["artifacts"][path.name] = json.loads(path.read_text())
@@ -156,6 +170,26 @@ def _recommend(profile: WorkloadProfile, priors: dict) -> TuningProfile:
              "candidates": profile.n_sampled,
              "threshold": OVERLAY_BORDER_SHARE},
         )
+
+    if profile.kind == "overlay" and profile.n_sampled:
+        speedup, artifact = _overlay_lane_prior(priors)
+        evidence = {
+            "candidates": profile.n_sampled,
+            "threshold": OVERLAY_DEVICE_CANDIDATES,
+            "artifact": artifact,
+            "speedup_vs_host": speedup,
+        }
+        if profile.n_sampled >= OVERLAY_DEVICE_CANDIDATES and (
+            speedup is None or speedup >= 1.0
+        ):
+            # the fused device lane wins once the fixed prep/launch cost is
+            # spread over enough pairs, provided the committed bench did not
+            # measure it losing to the host twin on this hardware
+            set_knob("overlay_lane", "device",
+                     "device-lane-amortized-candidates", evidence)
+        else:
+            set_knob("overlay_lane", "host",
+                     "small-candidate-host-lane", evidence)
 
     shares = profile.class_shares or {}
     dense = float(shares.get("heavy", 0.0)) + float(shares.get("convex", 0.0))
@@ -240,6 +274,25 @@ def _recommend(profile: WorkloadProfile, priors: dict) -> TuningProfile:
         rules=",".join(sorted({r["rule"] for r in why})),
     )
     return out
+
+
+def _overlay_lane_prior(priors: dict):
+    """The committed overlay bench's device-vs-host measurement, when one
+    exists: ``(speedup_vs_host, artifact)``. A measured speedup < 1.0 means
+    the fused device lane lost to the host numpy twin on this hardware, so
+    the router should keep candidates on the host lane regardless of size."""
+    speedup, artifact = None, None
+    for name, art in sorted(priors.get("artifacts", {}).items()):
+        if not name.startswith("OVERLAY_") or not isinstance(art, dict):
+            continue
+        detail = art.get("detail")
+        if not isinstance(detail, dict):
+            continue
+        s = detail.get("speedup_vs_host")
+        if isinstance(s, (int, float)):
+            # newest round wins (names sort by round suffix)
+            speedup, artifact = float(s), name
+    return speedup, artifact
 
 
 def _stream_pipeline_prior(priors: dict):

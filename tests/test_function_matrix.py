@@ -383,6 +383,14 @@ def _agg_specs(e):
             common, a_core, b_core, a_chips, b_chips,
             groups=np.zeros(len(common), dtype=np.int64),
         ),
+        # pair-level overlay measures (digest the folded area and value
+        # lanes; the trailing row stays out via an uncapped stream)
+        "st_intersection_area": lambda: F.st_intersection_area(
+            g, F.st_translate(g, 0.005, 0.005), idx, res
+        ).area,
+        "st_overlap_fraction": lambda: F.st_overlap_fraction(
+            g, F.st_translate(g, 0.005, 0.005), idx, res
+        ).value,
     }
 
 

@@ -69,6 +69,46 @@ def test_overlay_core_shortcut_counts():
     np.testing.assert_array_equal(got, [[0, 0]])
 
 
+def test_multi_cell_pair_emitted_once():
+    """Regression: a geometry pair sharing N cells must appear ONCE in
+    `candidate_pairs` (the raw chip-row stream emits it N times), and a
+    core chip in ANY shared cell must win over border-only cells."""
+    from mosaic_tpu.core.index import CustomIndexSystem, GridConf
+    from mosaic_tpu.core.tessellate import tessellate
+    from mosaic_tpu.sql.overlay import candidate_pairs, chip_candidate_rows
+
+    grid = CustomIndexSystem(GridConf(-180, 180, -90, 90, 2, 10.0, 10.0))
+    res = 3  # 1.25-degree cells
+    # geometry 0: big square spanning a 4x4 cell patch (core chips inside);
+    # geometry 1: thin all-border sliver sharing cells with the big square
+    left = wkt.from_wkt([
+        "POLYGON ((0.2 0.2, 4.8 0.2, 4.8 4.8, 0.2 4.8, 0.2 0.2))",
+        "POLYGON ((0.1 5.1, 4.9 5.1, 4.9 5.4, 0.1 5.4, 0.1 5.1))",
+    ])
+    right = wkt.from_wkt([
+        "POLYGON ((0.4 0.4, 4.6 0.4, 4.6 5.6, 0.4 5.6, 0.4 0.4))",
+    ])
+    lt = tessellate(left, grid, res)
+    rt = tessellate(right, grid, res)
+
+    lrows, rrows = chip_candidate_rows(lt, rt)
+    raw = np.stack(
+        [np.asarray(lt.geom_id)[lrows], np.asarray(rt.geom_id)[rrows]],
+        axis=-1,
+    )
+    # the raw stream really does repeat both pairs across shared cells —
+    # without that, this test would not pin the dedup at all
+    assert np.count_nonzero((raw == [0, 0]).all(axis=1)) > 1
+    assert np.count_nonzero((raw == [1, 0]).all(axis=1)) > 1
+
+    lgeom, rgeom, sure = candidate_pairs(lt, rt)
+    pairs = np.stack([lgeom, rgeom], axis=-1)
+    np.testing.assert_array_equal(pairs, [[0, 0], [1, 0]])
+    # core-beats-border: the big pair shares cells with core chips on
+    # both sides; the sliver pair is border-only everywhere
+    assert bool(sure[0]) and not bool(sure[1])
+
+
 def test_frame_level_overlay():
     from mosaic_tpu.sql.frame import MosaicFrame
 

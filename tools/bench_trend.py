@@ -43,6 +43,7 @@ PATTERNS = (
     "TUNE_r*.json",
     "SERVE_RESTART_r*.json",
     "SERVE_TENANT_r*.json",
+    "OVERLAY_r*.json",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)$")
