@@ -592,12 +592,26 @@ class DispatchCore:
     def _index_fingerprint(self) -> str:
         """Restart-stable tessellation identity for program-store keys
         (the in-process `dispatch_signature` keys on ``id(index)``,
-        which a restart recycles)."""
+        which a restart recycles). Epoch-aware: an index published by
+        `mosaic_tpu.index.epoch.EpochalIndex` folds its epoch token in,
+        so two epochs never share a key even when their cell sets
+        coincide bit-for-bit — loading a program exported against a
+        superseded chip table would bind the wrong epoch."""
         if getattr(self, "_index_fp", None) is None:
-            self._index_fp = _checkpoint.fingerprint(
-                np.asarray(self.index.cells)
-            )
+            self._index_fp = _checkpoint.index_identity(self.index)
         return self._index_fp
+
+    def _epoch_meta(self) -> dict:
+        """Epoch provenance for program-store sidecars (empty for
+        build-once indexes) — what `ProgramStore.gc_superseded` keys
+        on to drop entries from earlier epochs of the same series."""
+        series = getattr(self.index, "epoch_series", None)
+        if not series:
+            return {}
+        return {
+            "index_series": series,
+            "index_epoch": int(getattr(self.index, "epoch", 0)),
+        }
 
     def _aot_bundle(self, bucket: int):
         """The bucket's ``(cells_fn, join_fn)`` AOT pair: loaded from
@@ -642,7 +656,8 @@ class DispatchCore:
                 self, bucket, "cells")),
             lambda: cfn.lower(pts_proto).compile(),
             (pts_proto,), cells_aval,
-            meta={"kind": "cells", "bucket": bucket},
+            meta={"kind": "cells", "bucket": bucket,
+                  **self._epoch_meta()},
         )
 
         shifted_proto = _jax.ShapeDtypeStruct((bucket, 2), self._dtype)
@@ -662,7 +677,8 @@ class DispatchCore:
                 shifted_proto, cells_aval, self.index, **statics
             ).compile(),
             (shifted_proto, cells_aval, self.index), out_aval,
-            meta={"kind": "join", "bucket": bucket},
+            meta={"kind": "join", "bucket": bucket,
+                  **self._epoch_meta()},
         )
         return cells_fn, join_fn
 
@@ -845,6 +861,15 @@ class DispatchCore:
             out["backend_compiles"] = t1 - t0
         if self._programs is not None:
             out["aot"] = dict(self.aot_stats)
+            em = self._epoch_meta()
+            if em:
+                # this core IS the current epoch: entries exported for
+                # earlier epochs of the same series can never be loaded
+                # again (the epoch token is in their key) — drop them
+                # so a mutating index doesn't grow the store unbounded
+                out["aot_gc"] = self._programs.gc_superseded(
+                    em["index_series"], em["index_epoch"]
+                )
         _telemetry.record("dispatch_warmup", **out)
         return out
 

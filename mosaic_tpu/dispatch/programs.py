@@ -212,6 +212,49 @@ class ProgramStore:
         )
         return payload
 
+    def gc_superseded(self, series: str, keep_epoch: int) -> int:
+        """Drop every entry persisted for an EARLIER epoch of the same
+        index series (sidecar meta ``index_series``/``index_epoch``,
+        stamped by the dispatch core when its index carries an epoch).
+
+        Superseded entries are dead weight by construction — the epoch
+        token is part of their key, so they can never be loaded again —
+        but without GC a mutating index grows the store by one ladder of
+        programs per epoch. Entries from other series, from the current
+        (or a newer) epoch, or without epoch provenance are untouched.
+        Sidecar is unlinked FIRST so a kill mid-GC leaves an orphaned
+        payload (a cache miss), never a sidecar pointing at nothing.
+        """
+        removed = 0
+        for key in self.keys():
+            bin_path, json_path = self._paths(key)
+            try:
+                with open(json_path) as f:
+                    sidecar = json.load(f)
+            except (OSError, ValueError):
+                continue  # unreadable entries are load's problem, not GC's
+            meta = sidecar.get("meta") or {}
+            if meta.get("index_series") != series:
+                continue
+            try:
+                entry_epoch = int(meta["index_epoch"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if entry_epoch >= int(keep_epoch):
+                continue
+            for path in (json_path, bin_path):
+                try:
+                    os.unlink(path)
+                except FileNotFoundError:
+                    pass
+            removed += 1
+        if removed:
+            _telemetry.record(
+                "program_store_gc", root=self.root, series=series[:16],
+                keep_epoch=int(keep_epoch), removed=removed,
+            )
+        return removed
+
     def _corrupt(self, key: str, why: str):
         _telemetry.record(
             "program_store_corrupt_skipped", root=self.root, key=key,

@@ -154,3 +154,22 @@ def fingerprint(array) -> str:
     h.update(str((a.shape, str(a.dtype))).encode())
     h.update(np.ascontiguousarray(a).tobytes())
     return h.hexdigest()
+
+
+def index_identity(index) -> str:
+    """Restart-stable identity of one device join index.
+
+    The cells-array fingerprint alone is NOT enough once indexes mutate:
+    two epochs of an epochal index can share a cell set bit-for-bit
+    (a vertex nudged inside its cells) while their edge tables differ —
+    a program or snapshot keyed on cells alone would silently bind to
+    the wrong epoch. Indexes published by
+    `mosaic_tpu.index.epoch.EpochalIndex` carry an ``epoch_token``
+    attribute (series fingerprint + epoch counter + chain hash); it is
+    folded in whenever present, and plain build-once indexes keep the
+    bare cells fingerprint so their persisted program/snapshot keys
+    survive unchanged.
+    """
+    fp = fingerprint(np.asarray(index.cells))
+    token = getattr(index, "epoch_token", None)
+    return f"{fp}@{token}" if token else fp

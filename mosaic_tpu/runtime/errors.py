@@ -135,6 +135,42 @@ class RetryExhausted(MosaicRuntimeError):
         self.last = last
 
 
+class EpochLogCorrupt(MosaicRuntimeError):
+    """A delta-log record INSIDE the valid prefix failed validation
+    (unreadable sidecar, payload checksum mismatch, missing epoch in the
+    sequence) while LATER records are intact.
+
+    A corrupt *tail* is the expected kill-mid-write residue and is
+    silently truncated (``epoch_log_truncated`` telemetry); corruption
+    with valid successors means the bytes rotted or the directory was
+    spliced — replay refuses rather than reconstruct a wrong index.
+    """
+
+    def __init__(self, message: str, *, log_dir: str = "", epoch: int = -1):
+        super().__init__(message)
+        self.log_dir = log_dir
+        self.epoch = epoch
+
+
+class EpochFingerprintMismatch(MosaicRuntimeError):
+    """An epoch identity failed to line up: a delta record's ``prev``
+    hash does not chain from its predecessor, a compacted snapshot's
+    sealed prefix fingerprint disagrees with the surviving records, or a
+    durable-stream resume presented an index from a DIFFERENT epoch than
+    the snapshot was taken under. All are refusals — continuing would
+    mix chip tables from two epochs into one answer.
+    """
+
+    def __init__(
+        self, message: str, *, expected: str = "", actual: str = "",
+        epoch: int = -1,
+    ):
+        super().__init__(message)
+        self.expected = expected
+        self.actual = actual
+        self.epoch = epoch
+
+
 #: substrings that mark an exception as transient (observed in the wild:
 #: remote-compile HTTP 500s and tunnel drops on the axon rig, round 2/5;
 #: matched case-insensitively against ``repr(exc)``)

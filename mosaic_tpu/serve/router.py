@@ -74,7 +74,7 @@ class _Tenant:
     __slots__ = (
         "name", "index", "resolution", "profile", "engine_kw",
         "engine", "last_used", "submitted", "shed_admit", "revivals",
-        "last_metrics",
+        "last_metrics", "epoch", "epoch_advances",
     )
 
     def __init__(self, name, index, resolution, profile, engine_kw):
@@ -89,6 +89,8 @@ class _Tenant:
         self.shed_admit = 0
         self.revivals = 0
         self.last_metrics: dict = {}
+        self.epoch = getattr(index, "epoch", None)
+        self.epoch_advances = 0
 
 
 class ServeRouter:
@@ -314,6 +316,51 @@ class ServeRouter:
         _telemetry.record("router_swapped", tenant=tenant, **stats)
         return stats
 
+    def advance_epoch(
+        self, tenant: str, epochal, *, reprofile: bool = False,
+        **hot_swap_kw,
+    ) -> dict:
+        """Publish an :class:`~mosaic_tpu.index.epoch.EpochalIndex`'s
+        newest applied epoch into one tenant's engine, through the
+        ``router.swap`` guarded site.
+
+        The old-snapshot-keeps-serving contract: the new epoch's core is
+        built and warmed ASIDE (``hot_swap``'s discipline) — if the swap
+        fails, the guarded site raises, the tenant's engine keeps
+        answering from its current snapshot, the tenant's accounting is
+        untouched, AND the epochal index stays on its previous published
+        epoch (the delta log is already durable, so a later retry
+        publishes the same epoch). ``reprofile=True`` re-profiles the
+        mutated column through `tune` on the boundary."""
+        with self._lock:
+            t = self._require(tenant)
+            if t.engine is None:
+                self._revive(t)
+            engine = t.engine
+
+        class _Guarded:
+            """hot_swap proxied through the router's fault site."""
+
+            @staticmethod
+            def hot_swap(index, **kw):
+                return guarded_call(
+                    "router.swap", engine.hot_swap, index,
+                    retry=False, **kw,
+                )
+
+        stats = epochal.publish(
+            _Guarded, reprofile=reprofile, **hot_swap_kw
+        )
+        with self._lock:
+            t.index = epochal.index
+            t.epoch = epochal.epoch
+            t.epoch_advances += 1
+        _telemetry.record(
+            "router_epoch_advanced", tenant=tenant,
+            epoch=int(epochal.epoch), chips=stats.get("chips", 0),
+        )
+        return stats
+
     # ------------------------------------------------------- accounting
 
     def _require(self, tenant: str) -> _Tenant:
@@ -343,6 +390,8 @@ class ServeRouter:
                     submitted_router=t.submitted,
                     shed_admit_router=t.shed_admit,
                     revivals=t.revivals,
+                    epoch=t.epoch,
+                    epoch_advances=t.epoch_advances,
                 )
                 per[name] = m
             return {

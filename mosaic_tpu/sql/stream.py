@@ -84,7 +84,7 @@ from ..runtime import (
     quarantine as _quarantine,
     telemetry as _telemetry,
 )
-from ..runtime.errors import RetryExhausted
+from ..runtime.errors import EpochFingerprintMismatch, RetryExhausted
 from .join import (
     ChipIndex,
     host_join_with_cells,
@@ -882,6 +882,23 @@ class StreamJoin:
                 "snapshot ring fingerprint mismatch — this is not the "
                 "ring the interrupted run was folding"
             )
+        want_idx = meta.get("index_identity")
+        have_idx = _checkpoint.index_identity(self.index)
+        if want_idx and want_idx != have_idx:
+            # the epoch-boundary refusal: a resume must finish on the
+            # snapshot's epoch or not at all — folding batches joined
+            # against one epoch into accumulators from another would be
+            # a silent wrong answer (an epoch publish between the kill
+            # and the resume is the expected way to land here)
+            raise EpochFingerprintMismatch(
+                f"snapshot under {run_dir!r} was taken against index "
+                f"{want_idx[:24]}…, but this stream is bound to "
+                f"{have_idx[:24]}… — rebuild the stream on the "
+                "snapshot's epoch (EpochalIndex.replay of the matching "
+                "epoch) to finish this run, or start a fresh run on "
+                "the new epoch",
+                expected=want_idx, actual=have_idx,
+            )
         cells0 = (
             jnp.asarray(arrays["cells"]) if "cells" in arrays else None
         )
@@ -978,6 +995,7 @@ class StreamJoin:
             "prefetch": self.prefetch,
             "snapshot_every": int(snapshot_every),
             "ring_sha256": ring_fp,
+            "index_identity": _checkpoint.index_identity(self.index),
             "trace": root.context.as_dict(),
         }
         degraded_segments = 0
@@ -1181,6 +1199,7 @@ class StreamJoin:
             "prefetch": self.prefetch,
             "snapshot_every": int(snapshot_every),
             "ring_sha256": ring_fp,
+            "index_identity": _checkpoint.index_identity(self.index),
             "trace": root.context.as_dict(),
         }
         degraded = [0]

@@ -251,3 +251,96 @@ class TestCoreAOT:
         assert core._programs is None
         w = core.warmup()
         assert "aot" not in w
+
+
+# --------------------------------------------------- epochal provenance
+
+class TestEpochPrograms:
+    """ISSUE 18 regression: the store key must fold in the index's
+    EPOCH identity, not just its cell fingerprint — two epochs can
+    cover the exact same cells with different chip geometry, and a
+    stale program answering for the wrong epoch is silent corruption."""
+
+    ZONES = [
+        "POLYGON ((1 1, 13 2, 12 11, 6 14, 2 9, 1 1))",
+        "POLYGON ((-20 -20, -5 -20, -5 -5, -20 -5, -20 -20))",
+        "POLYGON ((20 -10, 30 -10, 30 5, 20 5, 20 -10))",
+    ]
+    #: zone 0 with one vertex nudged INSIDE its cells: the covered cell
+    #: set is unchanged, the chip geometry is not
+    ZONE0_NUDGED = "POLYGON ((1 1, 13 2.001, 12 11, 6 14, 2 9, 1 1))"
+
+    def _epochal(self, grid):
+        from mosaic_tpu.core.geometry import wkt as _wkt
+        from mosaic_tpu.index import EpochalIndex
+
+        ep = EpochalIndex(
+            _wkt.from_wkt(self.ZONES), grid, RES, keep_core_geoms=False
+        )
+        ep.publish()
+        return ep
+
+    def test_new_epoch_same_cells_never_loads_stale(
+        self, grid, tmp_path
+    ):
+        """Stale direction: a geometry edit that keeps the cell set
+        identical still changes the program identity — the new epoch
+        must export fresh programs, never load epoch-0's — and warmup
+        GCs the superseded epoch's entries."""
+        from mosaic_tpu.core.geometry import wkt as _wkt
+        from mosaic_tpu.runtime import checkpoint
+
+        ep = self._epochal(grid)
+        idx0 = ep.index
+        store = str(tmp_path)
+        w0 = make_core(idx0, grid, store).warmup()
+        assert w0["aot"] == {"loaded": 0, "exported": 6, "fallback": 0}
+        assert w0["aot_gc"] == 0
+
+        ep.apply(upsert=_wkt.from_wkt([self.ZONE0_NUDGED]), ids=[0])
+        ep.publish()
+        idx1 = ep.index
+        # the collision this regression pins: same cells, new epoch
+        np.testing.assert_array_equal(
+            np.asarray(idx0.cells), np.asarray(idx1.cells)
+        )
+        assert checkpoint.index_identity(idx0) != \
+            checkpoint.index_identity(idx1)
+
+        with telemetry.capture() as events:
+            w1 = make_core(idx1, grid, store).warmup()
+        assert w1["aot"] == {"loaded": 0, "exported": 6, "fallback": 0}
+        assert w1["aot_gc"] == 6  # epoch-0 ladder dropped
+        assert len(ProgramStore(store).keys()) == 6
+        assert any(
+            e.get("event") == "program_store_gc" for e in events
+        )
+
+    def test_same_epoch_reload_is_stable(self, grid, tmp_path):
+        """Stability direction: re-warming the SAME epoch is a pure
+        load — no re-export, no GC thrash."""
+        ep = self._epochal(grid)
+        store = str(tmp_path)
+        make_core(ep.index, grid, store).warmup()
+        w = make_core(ep.index, grid, store).warmup()
+        assert w["aot"] == {"loaded": 6, "exported": 0, "fallback": 0}
+        assert w["aot_gc"] == 0
+        assert len(ProgramStore(store).keys()) == 6
+
+    def test_gc_spares_other_series_and_unstamped(self, grid, tmp_path):
+        """gc_superseded only touches entries of the SAME series with an
+        OLDER epoch: plain (unstamped) indexes and foreign series
+        survive an epoch advance untouched."""
+        store = ProgramStore(str(tmp_path))
+        store.save("plain", b"x", meta={"kind": "cells"})
+        store.save("other", b"y", meta={
+            "index_series": "someoneelse", "index_epoch": 0,
+        })
+        store.save("mine-old", b"z", meta={
+            "index_series": "s1", "index_epoch": 0,
+        })
+        store.save("mine-new", b"w", meta={
+            "index_series": "s1", "index_epoch": 3,
+        })
+        assert store.gc_superseded("s1", 3) == 1
+        assert store.keys() == ["mine-new", "other", "plain"]

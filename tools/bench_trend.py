@@ -44,6 +44,7 @@ PATTERNS = (
     "SERVE_RESTART_r*.json",
     "SERVE_TENANT_r*.json",
     "OVERLAY_r*.json",
+    "EPOCH_r*.json",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)$")
