@@ -292,7 +292,10 @@ def jit_compact():
 
 #: factories whose cached VALUE is itself a jitted wrapper — clearing
 #: them must also drop the wrapper's compiled programs
-_JIT_FACTORIES = frozenset({"jit_join", "jit_counts", "jit_compact"})
+_JIT_FACTORIES = frozenset({
+    "jit_join", "jit_counts", "jit_compact",
+    "knn_pair_distance", "knn_point_pairs", "knn_point_pairs_sharded",
+})
 
 
 @bounded_cache("cells_prog", 64)

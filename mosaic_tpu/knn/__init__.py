@@ -1,0 +1,48 @@
+"""KNN-as-a-service: the serve frontend for K-nearest-neighbour queries.
+
+The batch model (`mosaic_tpu/models/knn.py`, reference
+`models/knn/SpatialKNN.scala:28-331`) answers KNN offline: tessellate the
+candidates, grow k-rings per landmark, evaluate pair distances in padded
+device batches. This package turns the same exact algorithm into an
+online frontend with the serving discipline the PIP path already has:
+
+- :func:`build_knn_index` — the resident artifact: candidate chips in a
+  sorted-cell CSR, the shifted device geometry column, a host f64 twin
+  (the brute-force oracle's data), and the chip index whose build
+  precomputed the Voronoi adjacency of convex chip sites
+  (`sql/join.VoronoiTables`).
+- :class:`KNNFrontend` — bucketed ring expansion: every ring
+  iteration's (query, candidate) pair batch pads to a
+  `dispatch.BucketLadder` rung, so each (pair bucket, index, mesh) is
+  exactly ONE compile signature with the candidate cap at the full
+  bucket (overflow structurally impossible — oversized batches chunk,
+  they never escalate). `warmup()` precompiles every rung (AOT
+  program-store export/load included) and freezes the signature set.
+  Fault/watchdog sites: ``knn.expand`` / ``knn.distance`` /
+  ``knn.scatter``; past the retry budget the distance batch degrades to
+  the exact host oracle. The Voronoi convex fast path collapses the
+  iterative loop into one guaranteed-cover dispatch (lane ``voronoi``,
+  routed by the tune profiler's convex-share statistic).
+- :func:`brute_force_knn` — the f64 host oracle, bit-identical to the
+  device path by construction (same shifted frame, same expression
+  order as `core/geometry/predicates.min_distance`).
+
+Serving integration lives in `mosaic_tpu/serve`: `ServeEngine(knn=...)`
+co-batches KNN requests with PIP traffic under one admission queue,
+deadline budget, and shed taxonomy; `ServeRouter.submit_knn` fronts it
+per tenant.
+"""
+
+from .index import KNNIndex, build_knn_index
+from .frontend import KNNAnswer, KNNFrontend, decode_knn
+from .oracle import brute_force_knn, host_pair_distances
+
+__all__ = [
+    "KNNAnswer",
+    "KNNFrontend",
+    "KNNIndex",
+    "brute_force_knn",
+    "build_knn_index",
+    "decode_knn",
+    "host_pair_distances",
+]

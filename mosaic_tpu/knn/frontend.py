@@ -1,0 +1,771 @@
+"""Bucketed ring-expansion KNN frontend with a Voronoi convex fast path.
+
+The batch model (`models/knn.SpatialKNN`, reference
+`models/knn/SpatialKNN.scala:28-331`) re-tessellates and re-jits per
+call; this frontend holds a :class:`~mosaic_tpu.knn.index.KNNIndex`
+resident and answers queries with the serving discipline of
+`dispatch.DispatchCore`:
+
+- **Shape discipline.** Every device entry runs at a `BucketLadder`
+  rung: cell assignment pads query rows to the row ladder, distance
+  evaluation pads (query, candidate) pairs to the pair ladder (oversize
+  batches CHUNK at the top rung — they never escalate, so the compile
+  signature set is closed under any traffic). Candidate caps don't
+  exist here at all: a pair batch is exact by construction, the full
+  bucket IS the cap.
+- **Compile accounting.** Signatures are `("knn", kind, bucket, mesh,
+  index fingerprint)`; :meth:`KNNFrontend.warmup` touches every rung and
+  freezes the set, after which any new signature counts as a cold
+  compile and fires ``on_cold_compile`` (the serve engine turns that
+  into a ``serve_compile`` event — the bench asserts zero).
+- **AOT persistence.** With a program store bound, each rung's cell and
+  pair executables export via `dispatch.programs` (keys
+  ``knn_cells``/``knn_pairs`` under the index fingerprint) and reload on
+  relaunch, so a store-backed restart replays with zero compiles.
+  Meshed executables bind a device topology the store does not model —
+  a meshed frontend refuses the store exactly like the core.
+- **Failure domains.** ``knn.expand`` (ring/walk candidate generation),
+  ``knn.distance`` (the device pair batch), and ``knn.scatter`` (top-k
+  merge) run under `dispatch.guarded_call`: watchdog deadline, transient
+  retry, fault-plan injection. Past the retry budget the distance batch
+  degrades to the exact f64 host oracle (`knn.oracle`) and the answer is
+  flagged :class:`~mosaic_tpu.runtime.errors.DegradedResult` — never
+  wrong, never dropped. Expand and scatter are pure functions whose
+  results commit only after the guarded call returns, so retries are
+  idempotent.
+
+Lanes
+-----
+``ring`` is the exact iterative lane: grow k-ring(1) then k-loop(i)
+shells per query (the batch model's loop, same stop rule: a query rests
+once the grid-guaranteed radius ``(it-1)*cell_width`` covers its current
+kth distance). ``voronoi`` collapses the loop: walk the precomputed
+Voronoi adjacency of convex chip sites (`sql.join.VoronoiTables`) to a
+near-nearest site, read ~k exact host distances to bound the kth
+neighbour, and dispatch ONE ring cover of grid radius
+``ceil(bound/w)+1`` — same pair program, same rungs, same exact answer,
+no iteration. Queries the walk cannot bound (fewer than k reachable
+convex geoms) fall back to the ring lane per query. Lane choice is the
+``knn_lane`` tune knob, routed by the profiler's convex-share statistic
+(`tune/recommend`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..dispatch import (
+    BucketLadder,
+    ProgramFingerprintMismatch,
+    ProgramStoreCorrupt,
+    backend_compiles,
+    bounded_cache,
+    cells_prog,
+    guarded_call,
+    mesh_key,
+    program_key,
+    resolve_mesh,
+    resolve_program_store,
+)
+from ..dispatch.programs import deserialize_compiled, serialize_compiled
+from ..obs import trace as _trace
+from ..runtime import telemetry as _telemetry
+from ..runtime.errors import DegradedResult
+from ..utils import get_logger
+from .index import KNNIndex
+from .oracle import host_pair_distances
+
+logger = get_logger(__name__)
+
+#: default pair ladder: 256 covers a handful of interactive queries'
+#: first rings, 16k is one comfortable cover dispatch; bigger pair sets
+#: chunk at the top rung (signature set stays closed)
+DEFAULT_PAIR_LADDER = BucketLadder(min_bucket=256, max_bucket=16384)
+#: default row ladder for query cell assignment
+DEFAULT_ROW_LADDER = BucketLadder(min_bucket=64, max_bucket=4096)
+
+
+@dataclasses.dataclass
+class KNNAnswer:
+    """Served neighbours (`-1`/`inf` pad unfilled slots when the index
+    holds fewer than k candidates). :meth:`KNNFrontend.query` returns
+    one per row with (k,) arrays; the serve engine's ``submit_knn``
+    future resolves to a single batched answer with (n, k) arrays."""
+
+    ids: np.ndarray  # (..., k) int64 candidate rows, rank order
+    distance: np.ndarray  # (..., k) f64
+    degraded: bool = False
+    reason: "str | None" = None
+
+
+def decode_knn(out: np.ndarray, k: int):
+    """Split the wire encoding ``[distances ‖ ids]`` (rows of width 2k,
+    the shape KNN answers travel through the mixed-traffic batcher in)
+    back into ``(ids int64, dist f64)``."""
+    out = np.asarray(out, dtype=np.float64)
+    dist = out[..., :k]
+    ids = out[..., k : 2 * k].astype(np.int64)
+    return ids, dist
+
+
+# ------------------------------------------------------- device programs
+
+
+def _point_column(qxy, shift):
+    """Synthesize a POINT DeviceGeometry column from (P, 2) coords
+    INSIDE the jit — one vertex per ring, closed form (the vertex
+    repeated at index ``ring_len``), so the pair kernel sees the exact
+    column `pack_to_device` would build for these points and the compile
+    signature depends only on P, never on the query values."""
+    import jax.numpy as jnp
+
+    from ..core.geometry.device import DeviceGeometry
+    from ..core.types import GeometryType
+
+    n = qxy.shape[0]
+    verts = jnp.broadcast_to(qxy[:, None, None, :], (n, 1, 2, 2))
+    return DeviceGeometry(
+        verts=verts,
+        ring_len=jnp.ones((n, 1), dtype=jnp.int32),
+        ring_is_hole=jnp.zeros((n, 1), dtype=bool),
+        n_rings=jnp.ones((n,), dtype=jnp.int32),
+        geom_type=jnp.full((n,), int(GeometryType.POINT), dtype=jnp.int32),
+        shift=shift,
+    )
+
+
+@bounded_cache("knn_point_pairs", 1)
+def _point_pair_prog():
+    """The ONE jitted (query point, candidate row) distance program all
+    frontends share — jax's own trace cache keys the pair-bucket shapes,
+    the frontend's ladder bounds how many there are. Lives in the
+    dispatch cache registry (name ``knn_point_pairs``) so
+    `cache_stats`/`clear_caches` cover it."""
+    import jax
+
+    from ..core.geometry.device import take_rows
+    from ..functions.geometry import _distance_dense, _vmap_pair
+
+    def run(dcs, qxy, crows):
+        dq = _point_column(qxy, dcs.shift)
+        return _vmap_pair(_distance_dense, dq, take_rows(dcs, crows))
+
+    return jax.jit(run)
+
+
+@bounded_cache("knn_point_pairs_sharded", 8)
+def _sharded_point_pairs(mesh):
+    """Meshed variant: candidate column replicated, query coords and
+    candidate rows sharded over the pair axis (the `parallel/dist_knn`
+    layout — embarrassingly parallel, no collectives)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..core.geometry.device import take_rows
+    from ..functions.geometry import _distance_dense, _vmap_pair
+    from ..parallel._compat import shard_map as _shard_map
+    from ..parallel.dist_overlay import geom_specs
+
+    row = P(mesh.axis_names)
+    rep = geom_specs(P())
+
+    def step(dcs, qxy, crows):
+        dq = _point_column(qxy, dcs.shift)
+        return _vmap_pair(_distance_dense, dq, take_rows(dcs, crows))
+
+    return jax.jit(
+        _shard_map(
+            step, mesh=mesh, in_specs=(rep, row, row), out_specs=row
+        )
+    )
+
+
+def _merge_topk(dist, cid, qi, ci, d, k):
+    """Pure top-k merge: fold (query, candidate, distance) triples into
+    the running (dist, cid) state, ranked lexicographically by
+    ``(distance, candidate_id)`` — the oracle's tie rule, and equal to
+    the batch model's insertion merge on tie-free data. Pairs are
+    deduplicated upstream (``seen`` sets), so a candidate can never
+    appear twice in one row."""
+    dist = dist.copy()
+    cid = cid.copy()
+    for i in np.unique(qi):
+        m = qi == i
+        cd = np.concatenate([dist[i], d[m]])
+        cc = np.concatenate([cid[i], ci[m]])
+        take = np.lexsort((cc, cd))[:k]
+        dist[i] = cd[take]
+        cid[i] = cc[take]
+    return dist, cid
+
+
+class KNNFrontend:
+    """Online KNN over a resident :class:`KNNIndex` (see module doc)."""
+
+    def __init__(
+        self,
+        kx: KNNIndex,
+        *,
+        lane: str = "ring",
+        pair_ladder: "BucketLadder | None" = None,
+        row_ladder: "BucketLadder | None" = None,
+        max_iterations: int = 64,
+        mesh=None,
+        program_store=None,
+        on_cold_compile=None,
+    ):
+        if lane not in ("ring", "voronoi"):
+            raise ValueError(f"unknown knn lane {lane!r}")
+        if kx.n == 0:
+            raise ValueError(
+                "KNNFrontend needs a non-empty candidate index (warmup "
+                "dispatches pair batches against candidate row 0)"
+            )
+        self.kx = kx
+        self.lane = lane
+        self.pair_ladder = pair_ladder or DEFAULT_PAIR_LADDER
+        self.row_ladder = row_ladder or DEFAULT_ROW_LADDER
+        self.max_iterations = int(max_iterations)
+        self.mesh = resolve_mesh(mesh)
+        if self.mesh is not None:
+            for b in self.pair_ladder.buckets:
+                if b % self.mesh.size:
+                    raise ValueError(
+                        f"pair bucket {b} does not divide over the "
+                        f"{self.mesh.size}-device mesh"
+                    )
+        self._dtype = np.dtype(kx.dc.verts.dtype)
+        self._signatures: set = set()
+        self._warmed: "frozenset | None" = None
+        self._cold_compiles = 0
+        self._on_cold_compile = on_cold_compile
+        # AOT persistence mirrors DispatchCore: explicit arg beats the
+        # MOSAIC_PROGRAM_STORE env knob; a meshed frontend refuses the
+        # store (sharded executables bind the device topology).
+        self._programs = resolve_program_store(program_store)
+        if self._programs is not None and self.mesh is not None:
+            _telemetry.record(
+                "program_store_refused", reason="mesh",
+                devices=self.mesh.size,
+            )
+            self._programs = None
+        self._aot: dict = {}  # (kind, bucket) -> compiled | None
+        self.aot_stats = {"loaded": 0, "exported": 0, "fallback": 0}
+        self.stats = {
+            "queries": 0,
+            "pairs": 0,
+            "pairs_padded": 0,
+            "iterations": 0,
+            "degraded": 0,
+            "lane_ring": 0,
+            "lane_voronoi": 0,
+            "voronoi_fallback": 0,
+        }
+
+    # ------------------------------------------------------- accounting
+
+    @property
+    def cold_compiles(self) -> int:
+        """Signatures first seen AFTER :meth:`warmup` froze the set."""
+        return self._cold_compiles
+
+    def signature_count(self) -> int:
+        return len(self._signatures)
+
+    def freeze(self) -> None:
+        self._warmed = frozenset(self._signatures)
+
+    def _note(self, kind: str, bucket: int) -> bool:
+        sig = (
+            "knn", kind, int(bucket), mesh_key(self.mesh),
+            self.kx.fingerprint,
+        )
+        if sig in self._signatures:
+            return False
+        self._signatures.add(sig)
+        if self._warmed is not None:
+            self._cold_compiles += 1
+            if self._on_cold_compile is not None:
+                self._on_cold_compile(bucket, len(self._signatures))
+            else:
+                _telemetry.record(
+                    "knn_compile", kind=kind, bucket=bucket,
+                    signatures=len(self._signatures),
+                )
+        return True
+
+    # ----------------------------------------------------- AOT programs
+
+    def _aot_program(self, kind: str, bucket: int):
+        key = (kind, bucket)
+        if key in self._aot:
+            return self._aot[key]
+        with _trace.span("knn.aot", kind=kind, bucket=bucket):
+            try:
+                fn = self._load_or_export(kind, bucket)
+            except Exception as e:  # lint: broad-except-ok (AOT is an optimization: ANY serialization failure must degrade to plain compilation, not take down the frontend)
+                _telemetry.record(
+                    "program_store_fallback", bucket=bucket,
+                    error=repr(e)[:200],
+                )
+                self.aot_stats["fallback"] += 1
+                fn = None
+        self._aot[key] = fn
+        return fn
+
+    def _load_or_export(self, kind: str, bucket: int):
+        import jax as _jax
+
+        fp = self.kx.fingerprint
+        if kind == "cells":
+            in_dtype = _jax.dtypes.canonicalize_dtype(np.float64)
+            proto = _jax.ShapeDtypeStruct((bucket, 2), in_dtype)
+            cfn = cells_prog(
+                self.kx.index_system, self.kx.resolution, "cells"
+            )
+            aval = _jax.eval_shape(cfn, proto)
+            return self._one_program(
+                program_key(
+                    fp, "knn_cells", bucket=bucket,
+                    resolution=int(self.kx.resolution),
+                ),
+                lambda: cfn.lower(proto).compile(),
+                (proto,), aval,
+                meta={"kind": "knn_cells", "bucket": bucket},
+            )
+        qproto = _jax.ShapeDtypeStruct((bucket, 2), self._dtype)
+        rdtype = _jax.dtypes.canonicalize_dtype(np.int64)
+        rproto = _jax.ShapeDtypeStruct((bucket,), rdtype)
+        prog = _point_pair_prog()
+        aval = _jax.eval_shape(prog, self.kx.dc, qproto, rproto)
+        return self._one_program(
+            program_key(
+                fp, "knn_pairs", bucket=bucket, dtype=str(self._dtype),
+            ),
+            lambda: prog.lower(self.kx.dc, qproto, rproto).compile(),
+            (self.kx.dc, qproto, rproto), aval,
+            meta={"kind": "knn_pairs", "bucket": bucket},
+        )
+
+    def _one_program(self, key, compile_fn, example_args, out_aval, meta):
+        payload = None
+        try:
+            payload = self._programs.load(key)
+        except (ProgramStoreCorrupt, ProgramFingerprintMismatch):
+            pass  # typed telemetry already recorded by the store
+        if payload is not None:
+            fn = deserialize_compiled(payload, example_args, out_aval)
+            self.aot_stats["loaded"] += 1
+            return fn
+        compiled = compile_fn()
+        self._programs.save(key, serialize_compiled(compiled), meta=meta)
+        self.aot_stats["exported"] += 1
+        return compiled
+
+    # ---------------------------------------------------- device entries
+
+    def _cells_bucket(self, padded: np.ndarray) -> np.ndarray:
+        """One full-bucket cell assignment (the shared `cells_prog`
+        executable, AOT-loaded when a store is bound)."""
+        import jax.numpy as jnp
+
+        b = padded.shape[0]
+        self._note("cells", b)
+        dev = jnp.asarray(padded)
+        fn = None
+        if self._programs is not None:
+            fn = self._aot_program("cells", b)
+        if fn is None:
+            fn = cells_prog(
+                self.kx.index_system, self.kx.resolution, "cells"
+            )
+        return np.asarray(fn(dev))
+
+    def _assign_cells(self, pts: np.ndarray) -> np.ndarray:
+        """(n, 2) raw query coords -> (n,) int64 seed cells, chunked
+        through the row ladder."""
+        n = pts.shape[0]
+        out = np.empty(n, dtype=np.int64)
+        step = self.row_ladder.max_bucket
+        for c0 in range(0, n, step):
+            chunk = pts[c0 : c0 + step]
+            m = chunk.shape[0]
+            padded, _ = self.row_ladder.pad(chunk)
+            cells = self._cells_bucket(padded)
+            out[c0 : c0 + m] = cells[:m].astype(np.int64)
+        return out
+
+    def _pair_bucket(self, qxy: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """One padded pair dispatch: (m, 2) shifted device-dtype query
+        coords × (m,) candidate rows -> (m,) f64 distances."""
+        import jax.numpy as jnp
+
+        m = qxy.shape[0]
+        b = self.pair_ladder.bucket_for(m)
+        if b > m:
+            # pad pairs repeat the first pair (inert, sliced off below)
+            qxy = np.concatenate(
+                [qxy, np.broadcast_to(qxy[:1], (b - m, 2))]
+            )
+            rows = np.concatenate(
+                [rows, np.broadcast_to(rows[:1], (b - m,))]
+            )
+        self._note("pairs", b)
+        self.stats["pairs"] += m
+        self.stats["pairs_padded"] += b
+        with _trace.span("knn.pairs", bucket=b, pairs=m):
+            qdev = jnp.asarray(np.ascontiguousarray(qxy), dtype=self._dtype)
+            rdev = jnp.asarray(np.ascontiguousarray(rows, dtype=np.int64))
+            if self.mesh is not None:
+                vals = _sharded_point_pairs(self.mesh)(
+                    self.kx.dc, qdev, rdev
+                )
+            else:
+                fn = None
+                if self._programs is not None:
+                    fn = self._aot_program("pairs", b)
+                if fn is None:
+                    fn = _point_pair_prog()
+                vals = fn(self.kx.dc, qdev, rdev)
+        return np.asarray(vals, dtype=np.float64)[:m]
+
+    def _pair_values(self, qsd, qi, ci) -> np.ndarray:
+        """All (query, candidate) pair distances, chunked at the top
+        pair rung (chunking keeps the signature set closed — an
+        arbitrarily large cover never invents a new shape)."""
+        total = qi.shape[0]
+        out = np.empty(total, dtype=np.float64)
+        step = self.pair_ladder.max_bucket
+        for c0 in range(0, total, step):
+            c1 = min(total, c0 + step)
+            out[c0:c1] = self._pair_bucket(qsd[qi[c0:c1]], ci[c0:c1])
+        return out
+
+    def _distances(self, qs64, qsd, qi, ci, default_s):
+        """The ``knn.distance`` failure domain: device pair batch with
+        watchdog + retry; past the budget the batch degrades to the
+        exact f64 host oracle (`DegradedResult`, never dropped)."""
+        if not qi.size:
+            return np.zeros(0)
+        return guarded_call(
+            "knn.distance",
+            lambda: self._pair_values(qsd, qi, ci),
+            default_s=default_s,
+            fallback=lambda: host_pair_distances(qs64, self.kx, qi, ci),
+        )
+
+    # ------------------------------------------------------- ring lane
+
+    def _ring_lane(self, pts, k, default_s):
+        """Exact iterative lane — the batch model's loop
+        (`models/knn.SpatialKNN.transform`) with serve discipline."""
+        kx = self.kx
+        n = pts.shape[0]
+        qs64 = pts - kx.shift
+        qsd = qs64.astype(self._dtype, copy=False)
+        dist = np.full((n, k), np.inf)
+        cid = np.full((n, k), -1, dtype=np.int64)
+        seen: list = [set() for _ in range(n)]
+        seeds = self._assign_cells(pts)
+        w = kx.cell_width
+        degraded = None
+        for it in range(1, self.max_iterations + 1):
+            # the batch model's rest criterion: a query rests once it
+            # holds k matches AND the grid-guaranteed covered radius
+            # (it-1)*w reaches its kth distance; candidate exhaustion
+            # rests it early (pure optimization — no candidates remain)
+            active = [
+                i
+                for i in range(n)
+                if len(seen[i]) < kx.n
+                and (
+                    int((cid[i] >= 0).sum()) < k
+                    or (it - 1) * w < dist[i, k - 1]
+                )
+            ]
+            if not active:
+                break
+            self.stats["iterations"] += 1
+
+            def expand():
+                # pure: fresh (query, sorted candidate rows) pairs; the
+                # ``seen`` commit happens AFTER the guarded call returns
+                # so a transient-fault retry re-reads identical state
+                found = []
+                for i in active:
+                    if it == 1:
+                        cells = np.asarray(
+                            kx.index_system.k_ring(seeds[i : i + 1], 1)
+                        )
+                    else:
+                        cells = np.asarray(
+                            kx.index_system.k_loop(seeds[i : i + 1], it)
+                        )
+                    cells = np.unique(cells[cells >= 0])
+                    rows = kx.candidate_rows(cells)
+                    fresh = sorted(set(rows.tolist()) - seen[i])
+                    if fresh:
+                        found.append((i, fresh))
+                return found
+
+            with _telemetry.timed(
+                "knn_stage", stage="expand", iteration=it,
+                queries=len(active),
+            ):
+                found = guarded_call("knn.expand", expand)
+            qi_l, ci_l = [], []
+            for i, fresh in found:
+                seen[i].update(fresh)
+                qi_l.extend([i] * len(fresh))
+                ci_l.extend(fresh)
+            qi = np.asarray(qi_l, dtype=np.int64)
+            ci = np.asarray(ci_l, dtype=np.int64)
+            if not qi.size:
+                continue
+            with _telemetry.timed(
+                "knn_stage", stage="distance", pairs=int(qi.size),
+            ):
+                d = self._distances(qs64, qsd, qi, ci, default_s)
+            if isinstance(d, DegradedResult):
+                degraded = degraded or d
+                d = np.asarray(d)
+            with _telemetry.timed(
+                "knn_stage", stage="scatter", pairs=int(qi.size),
+            ):
+                dist, cid = guarded_call(
+                    "knn.scatter",
+                    lambda: _merge_topk(dist, cid, qi, ci, d, k),
+                )
+        return dist, cid, degraded
+
+    # ---------------------------------------------------- voronoi lane
+
+    def _walk_rows(self, qv: np.ndarray, k: int):
+        """Greedy walk on the Voronoi adjacency to a locally nearest
+        convex site, then breadth-first neighbour collection until k
+        distinct candidate geoms are reachable. Returns (rows, ok)."""
+        vt = self.kx.voronoi
+        sites, adj = vt.sites, vt.adjacency
+        cv = sites.shape[0]
+        stride = max(1, cv // 64)
+        probe = np.arange(0, cv, stride)
+        d2 = np.sum((sites[probe] - qv) ** 2, axis=1)
+        cur = int(probe[int(np.argmin(d2))])
+        curd = float(np.sum((sites[cur] - qv) ** 2))
+        while True:
+            nbrs = adj[cur]
+            nbrs = nbrs[nbrs >= 0]
+            if not nbrs.size:
+                break
+            nd = np.sum((sites[nbrs] - qv) ** 2, axis=1)
+            j = int(np.argmin(nd))
+            if nd[j] < curd:
+                cur, curd = int(nbrs[j]), float(nd[j])
+            else:
+                break
+        rows = {int(vt.geom[cur])}
+        seen_sites = {cur}
+        frontier = [cur]
+        while frontier and len(rows) < k:
+            nxt = []
+            for s in frontier:
+                for t in adj[s]:
+                    t = int(t)
+                    if t < 0 or t in seen_sites:
+                        continue
+                    seen_sites.add(t)
+                    nxt.append(t)
+                    rows.add(int(vt.geom[t]))
+            frontier = nxt
+        return np.fromiter(sorted(rows), dtype=np.int64), len(rows) >= k
+
+    def _voronoi_lane(self, pts, k, default_s):
+        """One-shot exact lane: the walk's kth-distance bound collapses
+        ring iteration into a single guaranteed cover dispatch (grid
+        radius r satisfies (r-1)*w >= bound, the same guarantee the
+        iterative stop rule relies on — so the answer is the ring
+        lane's answer, computed in one device round-trip)."""
+        kx = self.kx
+        vt = kx.voronoi
+        n = pts.shape[0]
+        qs64 = pts - kx.shift
+        qsd = qs64.astype(self._dtype, copy=False)
+        qv = pts - vt.shift
+        w = kx.cell_width
+        dist = np.full((n, k), np.inf)
+        cid = np.full((n, k), -1, dtype=np.int64)
+        degraded = None
+
+        def expand():
+            # pure: per-query cover pairs + the indices the walk could
+            # not bound (they take the iterative lane below)
+            seeds = self._assign_cells(pts)
+            pairs, fallback = [], []
+            for i in range(n):
+                rows, ok = self._walk_rows(qv[i], k)
+                if not ok:
+                    fallback.append(i)
+                    continue
+                ds = host_pair_distances(
+                    qs64, kx, np.full(rows.shape[0], i, np.int64), rows
+                )
+                bound = float(np.partition(ds, k - 1)[k - 1])
+                r = int(np.ceil(bound / w)) + 1 if bound > 0 else 1
+                if r > self.max_iterations:
+                    fallback.append(i)
+                    continue
+                cells = np.asarray(
+                    kx.index_system.k_ring(seeds[i : i + 1], r)
+                )
+                cells = np.unique(cells[cells >= 0])
+                cover = kx.candidate_rows(cells)
+                pairs.append((i, np.sort(cover)))
+            return pairs, fallback
+
+        with _telemetry.timed(
+            "knn_stage", stage="expand", lane="voronoi", queries=n,
+        ):
+            pairs, fallback = guarded_call("knn.expand", expand)
+        self.stats["voronoi_fallback"] += len(fallback)
+        qi = np.concatenate(
+            [np.full(r.shape[0], i, np.int64) for i, r in pairs]
+        ) if pairs else np.zeros(0, dtype=np.int64)
+        ci = np.concatenate([r for _, r in pairs]) if pairs else np.zeros(
+            0, dtype=np.int64
+        )
+        if qi.size:
+            with _telemetry.timed(
+                "knn_stage", stage="distance", lane="voronoi",
+                pairs=int(qi.size),
+            ):
+                d = self._distances(qs64, qsd, qi, ci, default_s)
+            if isinstance(d, DegradedResult):
+                degraded = d
+                d = np.asarray(d)
+            with _telemetry.timed(
+                "knn_stage", stage="scatter", lane="voronoi",
+                pairs=int(qi.size),
+            ):
+                dist, cid = guarded_call(
+                    "knn.scatter",
+                    lambda: _merge_topk(dist, cid, qi, ci, d, k),
+                )
+        if fallback:
+            sub = np.asarray(fallback, dtype=np.int64)
+            fdist, fcid, fdeg = self._ring_lane(
+                pts[sub], k, default_s
+            )
+            dist[sub] = fdist
+            cid[sub] = fcid
+            degraded = degraded or fdeg
+        return dist, cid, degraded
+
+    # --------------------------------------------------------- serving
+
+    def dispatch(self, points: np.ndarray, k: int, default_s=None):
+        """Answer a batch: (n, 2) raw query coords -> ((n, 2k) f64 wire
+        rows ``[distances ‖ ids]``, pair-occupancy). Degraded batches
+        come back as :class:`DegradedResult` (values exact — the host
+        oracle computed them)."""
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        pts = np.asarray(points, dtype=np.float64)
+        n = pts.shape[0]
+        if n == 0:
+            return np.zeros((0, 2 * k)), 1.0
+        p0, b0 = self.stats["pairs"], self.stats["pairs_padded"]
+        lane = (
+            "voronoi"
+            if self.lane == "voronoi" and self.kx.voronoi is not None
+            else "ring"
+        )
+        with _trace.span("knn.dispatch", rows=n, k=k, lane=lane):
+            if lane == "voronoi":
+                dist, cid, deg = self._voronoi_lane(pts, k, default_s)
+            else:
+                dist, cid, deg = self._ring_lane(pts, k, default_s)
+        self.stats["queries"] += n
+        self.stats[f"lane_{lane}"] += n
+        out = np.empty((n, 2 * k))
+        out[:, :k] = dist
+        out[:, k:] = cid.astype(np.float64)
+        padded = self.stats["pairs_padded"] - b0
+        occupancy = (self.stats["pairs"] - p0) / padded if padded else 1.0
+        if deg is not None:
+            self.stats["degraded"] += n
+            return (
+                DegradedResult.wrap(
+                    out, reason=deg.reason, attempts=deg.attempts
+                ),
+                occupancy,
+            )
+        return out, occupancy
+
+    def query(self, points: np.ndarray, k: int) -> "list[KNNAnswer]":
+        """Direct (engine-less) entry: one :class:`KNNAnswer` per row."""
+        out, _ = self.dispatch(points, k)
+        degraded = isinstance(out, DegradedResult)
+        reason = out.reason if degraded else None
+        ids, dist = decode_knn(np.asarray(out), k)
+        return [
+            KNNAnswer(
+                ids=ids[i], distance=dist[i], degraded=degraded,
+                reason=reason,
+            )
+            for i in range(ids.shape[0])
+        ]
+
+    def warmup(self) -> dict:
+        """Touch every (kind, rung) pair so serving can only replay:
+        compiles (or AOT loads) every cell and pair program, then
+        freezes the signature set — any later signature is a cold
+        compile and fires ``on_cold_compile``."""
+        c0 = backend_compiles()
+        with _trace.span("knn.warmup"):
+            for b in self.row_ladder.buckets:
+                with _telemetry.timed(
+                    "knn_stage", stage="warmup", kind="cells", bucket=b,
+                ):
+                    self._cells_bucket(np.zeros((b, 2)))
+            for b in self.pair_ladder.buckets:
+                with _telemetry.timed(
+                    "knn_stage", stage="warmup", kind="pairs", bucket=b,
+                ):
+                    self._pair_bucket(
+                        np.zeros((b, 2), dtype=self._dtype),
+                        np.zeros(b, dtype=np.int64),
+                    )
+        self.freeze()
+        c1 = backend_compiles()
+        report = {
+            "signatures": len(self._signatures),
+            "row_buckets": len(self.row_ladder.buckets),
+            "pair_buckets": len(self.pair_ladder.buckets),
+            "backend_compiles": (
+                c1 - c0 if c0 is not None and c1 is not None else None
+            ),
+            "aot": dict(self.aot_stats),
+        }
+        _telemetry.record("knn_warmup", **report)
+        return report
+
+    def metrics(self) -> dict:
+        return {
+            "knn_queries": self.stats["queries"],
+            "knn_pairs": self.stats["pairs"],
+            "knn_pair_occupancy": (
+                self.stats["pairs"] / self.stats["pairs_padded"]
+                if self.stats["pairs_padded"]
+                else None
+            ),
+            "knn_iterations": self.stats["iterations"],
+            "knn_degraded": self.stats["degraded"],
+            "knn_lane_ring": self.stats["lane_ring"],
+            "knn_lane_voronoi": self.stats["lane_voronoi"],
+            "knn_voronoi_fallback": self.stats["voronoi_fallback"],
+            "knn_signatures": len(self._signatures),
+            "knn_cold_compiles": self._cold_compiles,
+            "knn_aot": dict(self.aot_stats),
+        }

@@ -38,6 +38,27 @@ def _pow2(n: int) -> int:
     return p
 
 
+@_dispatch.bounded_cache("knn_pair_distance", 1)
+def _pair_distance_prog():
+    """The process-wide jitted pairwise-distance program: gather both
+    DeviceGeometry columns by row, evaluate `_distance_dense` per pair.
+    ONE wrapper whose internal executable cache keys on the padded pair
+    width — registered in the dispatch cache registry so
+    ``cache_stats()``/``clear_caches()`` govern it like every other
+    compiled-program cache."""
+    import jax
+
+    from ..core.geometry.device import take_rows
+    from ..functions.geometry import _distance_dense, _vmap_pair
+
+    def run(dls, dcs, lrows, crows):
+        da = take_rows(dls, lrows)
+        db = take_rows(dcs, crows)
+        return _vmap_pair(_distance_dense, da, db)
+
+    return jax.jit(run)
+
+
 class GridRingNeighbours:
     """One iteration's candidate generation + distance evaluation
     (reference: GridRingNeighbours.transform / leftTransform:76-99).
@@ -50,7 +71,6 @@ class GridRingNeighbours:
         self.index = index
         self.resolution = resolution
         self.mesh = mesh
-        self._dist_cache: dict[int, object] = {}
 
     # ------------------------------------------------------------ cells
     def ring_cells(self, cover: list[np.ndarray], iteration: int) -> list[np.ndarray]:
@@ -77,11 +97,7 @@ class GridRingNeighbours:
         Pads the pair axis to a power of two so iterations share compiled
         kernels, then evaluates `_distance_dense` pairwise on device.
         """
-        import jax
         import jax.numpy as jnp
-
-        from ..core.geometry.device import take_rows
-        from ..functions.geometry import _distance_dense, _vmap_pair
 
         P = li.shape[0]
         if P == 0:
@@ -94,15 +110,12 @@ class GridRingNeighbours:
         lip = np.concatenate([li, np.zeros(Ppad - P, dtype=li.dtype)])
         cip = np.concatenate([ci, np.zeros(Ppad - P, dtype=ci.dtype)])
 
-        key = Ppad
-        if key not in self._dist_cache:
-            def run(dls, dcs, lrows, crows):
-                da = take_rows(dls, lrows)
-                db = take_rows(dcs, crows)
-                return _vmap_pair(_distance_dense, da, db)
-
-            self._dist_cache[key] = jax.jit(run)
-        out = self._dist_cache[key](dl, dc, jnp.asarray(lip), jnp.asarray(cip))
+        # the registered program cache (`_pair_distance_prog`) replaces
+        # the old per-instance dict: jit's executable cache keys on the
+        # padded width, so iterations still share compiles, but the
+        # cache is observable and clearable through dispatch.cache_stats
+        prog = _pair_distance_prog()
+        out = prog(dl, dc, jnp.asarray(lip), jnp.asarray(cip))
         return np.asarray(out, dtype=np.float64)[:P]
 
 

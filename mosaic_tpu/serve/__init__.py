@@ -14,6 +14,12 @@ join, with admission control in front and the PR-1..3 resilience stack
     fut = engine.submit(points)     # -> concurrent.futures.Future
     rows = fut.result(timeout=1.0)  # (n,) int32, -1 = no polygon
 
+KNN-as-a-service rides the same queue: ``engine = ServeEngine(...,
+knn=build_knn_index(...))`` lets ``engine.submit_knn(points, k)``
+co-batch k-nearest-neighbour requests with PIP traffic under one
+admission/deadline/shed budget (`mosaic_tpu/knn` owns the bucketed
+ring-expansion frontend and its Voronoi convex fast path).
+
 Component map: `bucket.py` (pad-to-bucket ladder + compile accounting),
 `admission.py` (bounded queue, deadlines, poison parking, typed
 ``Overloaded``), `batcher.py` (max-batch/max-wait coalescing with

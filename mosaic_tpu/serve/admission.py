@@ -53,6 +53,12 @@ class Request:
     n: int
     t_submit: float  # monotonic
     deadline: float | None  # monotonic instant, None = no deadline
+    #: request family sharing this queue: "pip" (point-in-polygon rows,
+    #: (n,) int32 answers) or "knn" ((n, 2k) f64 wire rows) — one
+    #: admission/deadline/shed budget covers both, the engine's dispatch
+    #: splits a mixed batch by kind
+    kind: str = "pip"
+    k: int = 0  # neighbour count, kind == "knn" only
     parked: int = 0  # rows diverted to quarantine
     quarantine: "_quarantine.QuarantineReport | None" = None
     #: caller-thread context the dispatch worker adopts (both are
@@ -116,10 +122,18 @@ class AdmissionController:
     # ------------------------------------------------------ submit side
 
     def admit(
-        self, points: np.ndarray, *, deadline_s: float | None = None
+        self,
+        points: np.ndarray,
+        *,
+        deadline_s: float | None = None,
+        kind: str = "pip",
+        k: int = 0,
     ) -> Request:
         """Scrub, stamp, and enqueue one request; returns it with its
-        future. Raises :class:`Overloaded` when the queue is full."""
+        future. Raises :class:`Overloaded` when the queue is full.
+        ``kind``/``k`` route the request family (KNN requests co-batch
+        with PIP traffic under this same queue, deadline budget, and
+        shed taxonomy)."""
         _faults.maybe_fail("serve.admit")
         raw = np.asarray(
             _faults.maybe_corrupt("serve.admit", points), dtype=np.float64
@@ -133,18 +147,20 @@ class AdmissionController:
         # request's whole lifecycle is ONE span, its stages children
         root = _trace.start_span(
             "serve.request", detached=True, rows=int(raw.shape[0]),
+            kind=kind,
         )
         try:
             with _trace.span(
                 "serve.admit", parent=root.context, rows=int(raw.shape[0]),
             ):
-                return self._admit_scrubbed(raw, deadline_s, root)
+                return self._admit_scrubbed(raw, deadline_s, root, kind, k)
         except BaseException as e:  # noqa: BLE001 — span closed, re-raised
             root.end(error=type(e).__name__)
             raise
 
     def _admit_scrubbed(
-        self, raw: np.ndarray, deadline_s: float | None, root
+        self, raw: np.ndarray, deadline_s: float | None, root,
+        kind: str = "pip", k: int = 0,
     ) -> Request:
         report = None
         parked = 0
@@ -172,6 +188,8 @@ class AdmissionController:
             n=int(raw.shape[0]),
             t_submit=now,
             deadline=None if deadline_s is None else now + float(deadline_s),
+            kind=kind,
+            k=int(k),
             parked=parked,
             quarantine=report,
             sinks=_telemetry.current_sinks(),

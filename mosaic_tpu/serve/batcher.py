@@ -47,13 +47,18 @@ from .admission import AdmissionController, Request
 class MicroBatcher:
     """Background coalescing loop over an :class:`AdmissionController`.
 
-    ``dispatch(points, deadline_hint)`` is the engine-supplied function
-    mapping a concatenated ``(n, 2)`` f64 array to ``(results (n,)
-    int32, occupancy)`` (padding, bucketing, retry, and degradation live
-    there; the hint — the batch's largest remaining request budget in
-    seconds — becomes the watchdog default); the batcher owns request
-    lifecycle: coalescing, deadline shedding, scatter-back, and future
-    resolution.
+    ``dispatch(points, deadline_hint, reqs)`` is the engine-supplied
+    function mapping a concatenated ``(n, 2)`` f64 array to
+    ``(results, occupancy)`` (padding, bucketing, retry, and degradation
+    live there; the hint — the batch's largest remaining request budget
+    in seconds — becomes the watchdog default; ``reqs`` is the live
+    request list in concatenation order, which lets the engine split a
+    mixed PIP/KNN batch by ``Request.kind`` and answer each segment in
+    its own wire shape). The result only needs ``out[off : off + n]``
+    slicing at the request boundaries — a plain (n,) array for uniform
+    batches, the engine's segment view for mixed ones. The batcher owns
+    request lifecycle: coalescing, deadline shedding, scatter-back, and
+    future resolution.
     """
 
     def __init__(
@@ -176,14 +181,18 @@ class MicroBatcher:
                 # largest remaining request budget (None = no deadline)
                 rem = [r.remaining(now) for r in live]
                 hint = max(rem) if all(np.isfinite(rem)) else None
-                out, occupancy = self.dispatch(points, hint)
+                out, occupancy = self.dispatch(points, hint, live)
             self.metrics["occupancy_sum"] += float(occupancy)
         except BaseException as e:  # noqa: BLE001 — delivered per-future
             for req in live:
                 self._fail(req, e)
             return
 
-        degraded = isinstance(out, DegradedResult)
+        # mixed-batch segment views flag degradation via a plain
+        # attribute (they are not ndarray subclasses)
+        degraded = isinstance(out, DegradedResult) or bool(
+            getattr(out, "degraded", False)
+        )
         now = time.monotonic()
         off = 0
         for req in live:

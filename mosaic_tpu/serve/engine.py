@@ -35,6 +35,7 @@ bit-identical results at any device count.
 from __future__ import annotations
 
 import threading
+from concurrent.futures import Future
 
 import numpy as np
 
@@ -46,11 +47,40 @@ from ..dispatch import (
 )
 from ..obs import trace as _trace
 from ..runtime import telemetry as _telemetry
+from ..runtime.errors import DegradedResult
 from ..tune.resolve import resolve_knobs
 from .admission import AdmissionController
 from .batcher import MicroBatcher
 
 import jax.numpy as jnp
+
+
+class _MixedOut:
+    """Result view for a mixed-kind batch: per-request answer segments
+    keyed by their ``(start, stop)`` row interval in the concatenated
+    batch. Requests are never split across batches, so the batcher's
+    scatter-back slices ``out[off : off + req.n]`` land exactly on these
+    keys — each request reads its own wire shape ((n,) int32 for PIP,
+    (n, 2k) f64 for KNN) with no common dtype forced on the batch.
+
+    ``degraded`` is batch-level and conservative: if ANY segment fell
+    back to the host oracle, every request in the batch is flagged
+    (values are exact either way — degradation changes provenance, not
+    answers)."""
+
+    def __init__(self, segments, *, degraded=False, reason=None, attempts=0):
+        self._segments = segments
+        self.degraded = bool(degraded)
+        self.reason = reason
+        self.attempts = attempts
+
+    def __getitem__(self, sl: slice):
+        seg = self._segments.get((sl.start, sl.stop))
+        if seg is None:
+            raise KeyError(
+                f"no batch segment at rows [{sl.start}, {sl.stop})"
+            )
+        return seg
 
 
 class ServeEngine:
@@ -83,6 +113,8 @@ class ServeEngine:
         mesh=None,
         profile=None,
         program_store=None,
+        knn=None,
+        knn_lane: str | None = None,
     ):
         self.index = index
         self.index_system = index_system
@@ -95,10 +127,12 @@ class ServeEngine:
             explicit={
                 "probe": probe, "writeback": writeback, "lookup": lookup,
                 "bucket_min": None, "bucket_max": None,
+                "knn_lane": knn_lane,
             },
             defaults={
                 "probe": "scatter", "writeback": "scatter", "lookup": None,
                 "bucket_min": None, "bucket_max": None,
+                "knn_lane": None,
             },
         )
         probe, writeback, lookup = (
@@ -134,6 +168,12 @@ class ServeEngine:
         self.probe = self.core.probe
         self.lookup = self.core.lookup
         self.mesh = self.core.mesh
+        # optional KNN frontend riding the same queue/batcher: a
+        # KNNIndex builds a fresh frontend sharing the engine's mesh,
+        # program store, and cold-compile tripwire; an existing
+        # KNNFrontend is adopted as-is (tests pre-warm one)
+        self.knn_lane = knobs["knn_lane"]
+        self.knn = self._build_knn(knn, self.knn_lane)
 
         self.admission = AdmissionController(
             capacity=queue_capacity,
@@ -183,6 +223,44 @@ class ServeEngine:
         """Synchronous convenience wrapper: submit and wait."""
         return self.submit(points, deadline_s=deadline_s).result(timeout)
 
+    def submit_knn(self, points, k: int, *, deadline_s: float | None = None):
+        """Enqueue one k-nearest-neighbour request; returns a Future
+        resolving to a :class:`~mosaic_tpu.knn.frontend.KNNAnswer` with
+        (n, k) ``ids``/``distance`` arrays (:class:`Overloaded` when
+        shed). KNN requests ride the SAME admission queue, deadline
+        budget, micro-batch window, and shed taxonomy as PIP traffic —
+        the dispatch splits a mixed batch by ``Request.kind`` and each
+        family keeps its exact answers. Quarantined (non-finite /
+        out-of-bounds) rows answer ``ids=-1, distance=inf``."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if self.knn is None:
+            raise RuntimeError(
+                "engine has no KNN frontend — pass knn= at construction "
+                "or hot_swap(knn=...)"
+            )
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        pts = np.asarray(points, dtype=np.float64)
+        if pts.ndim != 2 or pts.shape[1] != 2:
+            raise ValueError(f"expected (n, 2) points, got {pts.shape}")
+        if pts.shape[0] > self.ladder.max_bucket:
+            raise ValueError(
+                f"request of {pts.shape[0]} rows exceeds the top bucket "
+                f"{self.ladder.max_bucket} — split it upstream"
+            )
+        req = self.admission.admit(pts, deadline_s=deadline_s, kind="knn", k=k)
+        return _decode_knn_future(req, k)
+
+    def join_knn(
+        self, points, k: int, *, deadline_s: float | None = None, timeout=None
+    ):
+        """Synchronous convenience wrapper: submit_knn and wait."""
+        return self.submit_knn(points, k, deadline_s=deadline_s).result(
+            timeout
+        )
+
     def warmup(self) -> dict:
         """Precompile every ladder bucket against the resident index.
 
@@ -205,6 +283,8 @@ class ServeEngine:
                     "serve_stage", stage="warmup", bucket=b
                 ):
                     self.core.execute_padded(pts)
+            if self.knn is not None:
+                knn_stats = self.knn.warmup()
         total = sum(
             e["seconds"]
             for e in events
@@ -221,6 +301,8 @@ class ServeEngine:
             out["backend_compiles"] = t1 - t0
         if self.program_store is not None:
             out["aot"] = dict(self.core.aot_stats)
+        if self.knn is not None:
+            out["knn"] = knn_stats
         _telemetry.record("serve_warmup", **out)
         return out
 
@@ -234,6 +316,8 @@ class ServeEngine:
         writeback: str | None = None,
         lookup: str | None = None,
         ladder: BucketLadder | None = None,
+        knn=None,
+        knn_lane: str | None = None,
     ) -> dict:
         """Swap in a new index and/or `TuningProfile` without dropping
         the engine: a NEW dispatch core is built off to the side, its
@@ -285,11 +369,21 @@ class ServeEngine:
                 program_store=self.program_store,
             )
             stats = core.warmup()  # precompiles every rung, then freezes
+            # a new KNN index swaps the same way: frontend built and
+            # warmed off to the side, rebound atomically with the core
+            # (in-flight mixed batches already hold their snapshot)
+            new_knn = self.knn
+            if knn is not None:
+                new_knn = self._build_knn(
+                    knn, knn_lane or self.knn_lane
+                )
+                stats["knn"] = new_knn.warmup()
             with self._swap_lock:
                 self.index = index
                 self.resolution = new_resolution
                 self.ladder = ladder
                 self.core = core
+                self.knn = new_knn
                 self.writeback = knobs["writeback"]
                 self.probe = core.probe
                 self.lookup = core.lookup
@@ -309,6 +403,9 @@ class ServeEngine:
         out["queue_depth"] = self.admission.depth()
         out["compile_signatures"] = len(self.core.signatures)
         out["cold_compiles"] = self.core.cold_compiles
+        if self.knn is not None:
+            out.update(self.knn.metrics())
+            out["cold_compiles"] += self.knn.cold_compiles
         out["occupancy_mean"] = round(
             b["occupancy_sum"] / b["batches"], 4
         ) if b["batches"] else 0.0
@@ -329,13 +426,37 @@ class ServeEngine:
 
     # --------------------------------------------------------- dispatch
 
-    def _dispatch(self, points: np.ndarray, deadline_hint=None):
+    def _build_knn(self, knn, lane):
+        """Wrap a KNNIndex in a frontend sharing the engine's mesh,
+        program store, and cold-compile tripwire; pass a ready-made
+        frontend through unchanged; None stays None."""
+        if knn is None:
+            return None
+        from ..knn.frontend import KNNFrontend
+
+        if isinstance(knn, KNNFrontend):
+            return knn
+        return KNNFrontend(
+            knn,
+            lane=lane or "ring",
+            mesh=self.mesh,
+            program_store=self.program_store,
+            on_cold_compile=self._on_cold_compile,
+        )
+
+    def _dispatch(self, points: np.ndarray, deadline_hint=None, reqs=None):
         """Batcher callback: pad, dispatch with resilience, unpad.
-        Returns ``(results (n,), occupancy)``."""
-        # snapshot the (ladder, core) pair so a concurrent hot_swap can
-        # never pad with one ladder and execute on the other core
+        Returns ``(results, occupancy)`` — a plain (n,) array for a
+        uniform PIP batch, a :class:`_MixedOut` segment view when the
+        batch carries KNN requests."""
+        # snapshot the swap unit so a concurrent hot_swap can never pad
+        # with one ladder and execute on the other core
         with self._swap_lock:
-            ladder, core = self.ladder, self.core
+            ladder, core, knn = self.ladder, self.core, self.knn
+        if reqs is not None and any(r.kind == "knn" for r in reqs):
+            return self._dispatch_mixed(
+                ladder, core, knn, points, deadline_hint, reqs
+            )
         padded, n = ladder.pad(points)
         bucket = padded.shape[0]
         with _trace.span(
@@ -346,6 +467,84 @@ class ServeEngine:
             out = self._dispatch_resilient(core, padded, deadline_hint)
         occupancy = n / bucket
         return out[:n], occupancy
+
+    def _dispatch_mixed(self, ladder, core, knn, points, deadline_hint, reqs):
+        """Split a mixed batch by request kind: ALL PIP rows go through
+        one padded core dispatch (their co-batching benefit is
+        unchanged), KNN rows group by k into one frontend dispatch each.
+        Answers come back as a :class:`_MixedOut` keyed by each request's
+        row interval; occupancy is the rows-weighted mean over the
+        device dispatches actually issued."""
+        bounds, off = [], 0
+        for r in reqs:
+            bounds.append((r, off, off + r.n))
+            off += r.n
+        segs = {}
+        degraded, reason, attempts = False, None, 0
+        occ_rows, rows_total = 0.0, 0
+
+        pip = [(r, a, b) for (r, a, b) in bounds if r.kind != "knn"]
+        if pip:
+            pts = np.concatenate([points[a:b] for (_r, a, b) in pip])
+            padded, n = ladder.pad(pts)
+            bucket = padded.shape[0]
+            with _trace.span(
+                "serve.dispatch", bucket=bucket, rows=n,
+            ), _telemetry.timed(
+                "serve_stage", stage="dispatch", bucket=bucket, rows=n,
+            ):
+                out = self._dispatch_resilient(core, padded, deadline_hint)
+            if isinstance(out, DegradedResult):
+                degraded, reason, attempts = True, out.reason, out.attempts
+            vals = np.asarray(out[:n])
+            o = 0
+            for (r, a, b) in pip:
+                segs[(a, b)] = vals[o : o + r.n]
+                o += r.n
+            occ_rows += (n / bucket) * n
+            rows_total += n
+
+        knn_reqs = [(r, a, b) for (r, a, b) in bounds if r.kind == "knn"]
+        if knn_reqs:
+            if knn is None:
+                raise RuntimeError(
+                    "KNN request admitted but the engine has no KNN frontend"
+                )
+            default_s = (
+                None
+                if deadline_hint is None
+                else max(float(deadline_hint), 0.05) + self.watchdog_grace_s
+            )
+            by_k: dict[int, list] = {}
+            for item in knn_reqs:
+                by_k.setdefault(item[0].k, []).append(item)
+            for k, group in sorted(by_k.items()):
+                pts = np.concatenate([points[a:b] for (_r, a, b) in group])
+                n = int(pts.shape[0])
+                with _trace.span(
+                    "serve.dispatch", rows=n, kind="knn", k=k,
+                ), _telemetry.timed(
+                    "serve_stage", stage="dispatch", rows=n,
+                    kind="knn", k=k,
+                ):
+                    out, occ = knn.dispatch(pts, k, default_s=default_s)
+                if isinstance(out, DegradedResult):
+                    degraded, reason, attempts = (
+                        True, out.reason, out.attempts
+                    )
+                vals = np.asarray(out)
+                o = 0
+                for (r, a, b) in group:
+                    segs[(a, b)] = vals[o : o + r.n]
+                    o += r.n
+                occ_rows += float(occ) * n
+                rows_total += n
+
+        occupancy = occ_rows / rows_total if rows_total else 1.0
+        view = _MixedOut(
+            segs, degraded=degraded, reason=reason, attempts=attempts
+        )
+        return view, occupancy
 
     def _on_cold_compile(self, bucket: int, signatures: int) -> None:
         """Core callback: a post-warmup dispatch introduced a new
@@ -395,3 +594,47 @@ class ServeEngine:
         return _quarantine.find_park_point(
             assign, np.asarray(self.index.cells), bounds
         )
+
+
+def _decode_knn_future(req, k: int) -> Future:
+    """Chain the request's raw wire future ((n, 2k) f64 rows) into one
+    resolving to a batched :class:`~mosaic_tpu.knn.frontend.KNNAnswer`.
+    Quarantined rows were answered at the park point — mask them back to
+    the sentinel (``ids=-1, distance=inf``) so a poisoned coordinate can
+    never surface a real neighbour. Exceptions (Overloaded sheds,
+    injected faults) pass through untranslated."""
+    from ..knn.frontend import KNNAnswer, decode_knn
+
+    fut: Future = Future()
+
+    def _done(raw: Future) -> None:
+        if raw.cancelled():
+            fut.cancel()
+            return
+        exc = raw.exception()
+        if exc is not None:
+            fut.set_exception(exc)
+            return
+        try:
+            out = raw.result()
+            degraded = isinstance(out, DegradedResult) or bool(
+                getattr(out, "degraded", False)
+            )
+            reason = getattr(out, "reason", None) if degraded else None
+            ids, dist = decode_knn(np.asarray(out), k)
+            if req.quarantine is not None:
+                dist = dist.copy()
+                bad = [r for (_b, r) in req.quarantine.rows]
+                ids[bad] = -1
+                dist[bad] = np.inf
+            fut.set_result(
+                KNNAnswer(
+                    ids=ids, distance=dist,
+                    degraded=degraded, reason=reason,
+                )
+            )
+        except BaseException as e:  # noqa: BLE001 — delivered via future
+            fut.set_exception(e)
+
+    req.future.add_done_callback(_done)
+    return fut

@@ -293,6 +293,42 @@ class ServeRouter:
             tenant, points, deadline_s=deadline_s
         ).result(timeout)
 
+    def submit_knn(
+        self, tenant: str, points, k: int,
+        *, deadline_s: float | None = None,
+    ):
+        """Admit one KNN request for ``tenant`` (engine configured with
+        ``knn=``); same quota/revival/shed semantics as :meth:`submit`,
+        future resolves to a batched
+        :class:`~mosaic_tpu.knn.frontend.KNNAnswer`."""
+        if self._closed:
+            raise RuntimeError("router is closed")
+        with _telemetry.timed(
+            "router_stage", stage="admit", tenant=tenant, kind="knn",
+        ):
+            _faults.maybe_fail("router.admit")
+            with self._lock:
+                t = self._require(tenant)
+                if t.engine is None:
+                    self._revive(t)
+                t.last_used = time.monotonic()
+                t.submitted += 1
+                engine = t.engine
+        try:
+            return engine.submit_knn(points, k, deadline_s=deadline_s)
+        except Overloaded as e:
+            t.shed_admit += 1
+            _metrics.counter(
+                "serve.router_shed", "router-level per-tenant sheds",
+            ).inc(tenant=tenant, reason=e.reason)
+            raise
+
+    def join_knn(self, tenant, points, k, *, deadline_s=None, timeout=None):
+        """Synchronous convenience wrapper: submit_knn and wait."""
+        return self.submit_knn(
+            tenant, points, k, deadline_s=deadline_s
+        ).result(timeout)
+
     def swap(self, tenant: str, index=None, **hot_swap_kw) -> dict:
         """Hot-swap one tenant's index/profile under the
         ``router.swap`` fault/watchdog site — the engine's swap

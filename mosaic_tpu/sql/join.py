@@ -637,7 +637,118 @@ def build_chip_index(
         shift=shift64,
         coord_scale=coord_scale,
     )
+    # Voronoi adjacency of the convex chip sites — same non-pytree
+    # discipline as ``host`` above; consumed by the KNN serve frontend's
+    # convex fast path (mosaic_tpu/knn/frontend.py)
+    idx.voronoi = _build_voronoi_tables(
+        uniq, cell_convex, epc, cell_edges64, convex_geom, shift64
+    )
     return idx
+
+
+@dataclasses.dataclass
+class VoronoiTables:
+    """Host-side Voronoi adjacency of the convex chip sites (PAPERS.md:
+    *A Novel Point Inclusion Test for Convex Polygons Based on Voronoi
+    Tessellations*): one site per convex-lane cell (the single chip's
+    vertex centroid), with the Delaunay-dual neighbour lists that make
+    "move to the adjacent site closer to the query" walks possible.
+
+    The KNN serve frontend (`mosaic_tpu/knn`) uses the walk twice: to
+    order ring expansion by neighbour-of-current-nearest, and to derive
+    a kth-distance upper bound that collapses the iterative ring loop
+    into one guaranteed-cover dispatch. Correctness never depends on the
+    adjacency (the ring cover guarantee is what is exact) — adjacency
+    quality only affects how tight the bound is, which is why the
+    scipy-less fallback (nearest-``DEG`` sites) is sound.
+
+    Like :class:`HostRecheck` this is a plain attribute on the built
+    index, deliberately OUTSIDE the pytree — the walk is host work.
+
+    sites:    (Cv, 2) f64 — convex chip vertex centroids (recentred frame).
+    adjacency:(Cv, DEG) int32 — neighbouring convex rows, -1 padded.
+    geom:     (Cv,) int32 — the site's source polygon row (== convex_geom).
+    cell:     (Cv,) int64 — the site's cell id.
+    shift:    (2,) f64 — the recenter origin of ``sites`` (same frame as
+              :class:`HostRecheck`); walks subtract it from raw queries.
+    method:   "delaunay" | "nearest" — how adjacency was derived.
+    """
+
+    sites: np.ndarray
+    adjacency: np.ndarray
+    geom: np.ndarray
+    cell: np.ndarray
+    shift: np.ndarray
+    method: str
+
+    @property
+    def num_sites(self) -> int:
+        return int(self.sites.shape[0])
+
+
+def _voronoi_adjacency(sites: np.ndarray):
+    """(Cv, DEG) int32 neighbour lists. Prefers the true Delaunay dual
+    (scipy, when the container has it); degrades to the nearest-DEG
+    heuristic — a superset-free approximation that only loosens the
+    walk's bound, never the exactness of the ring cover pass."""
+    Cv = sites.shape[0]
+    if Cv <= 1:
+        return np.full((Cv, 1), -1, dtype=np.int32), "nearest"
+    neigh = [set() for _ in range(Cv)]
+    method = "nearest"
+    if Cv >= 4:
+        try:
+            from scipy.spatial import Delaunay  # noqa: PLC0415
+
+            tri = Delaunay(sites)
+            for simplex in tri.simplices:
+                for i in simplex:
+                    for j in simplex:
+                        if i != j:
+                            neigh[i].add(int(j))
+            method = "delaunay"
+        except Exception:  # lint: broad-except-ok (scipy absent or degenerate site set — the nearest-neighbour fallback below is always available)
+            method = "nearest"
+    if method == "nearest":
+        deg = min(8, Cv - 1)
+        d2 = ((sites[:, None, :] - sites[None, :, :]) ** 2).sum(axis=-1)
+        np.fill_diagonal(d2, np.inf)
+        nearest = np.argsort(d2, axis=1, kind="stable")[:, :deg]
+        for i in range(Cv):
+            neigh[i].update(int(j) for j in nearest[i])
+            # symmetrize so walks can traverse in both directions
+            for j in nearest[i]:
+                neigh[int(j)].add(i)
+    deg = max(1, max(len(s) for s in neigh))
+    adj = np.full((Cv, deg), -1, dtype=np.int32)
+    for i, s in enumerate(neigh):
+        row = sorted(s)
+        adj[i, : len(row)] = row
+    return adj, method
+
+
+def _build_voronoi_tables(
+    uniq, cell_convex, epc, cell_edges, convex_geom, shift
+) -> VoronoiTables:
+    """Host: site + adjacency tables over the convex-lane cells, built
+    next to the y-bucketed convex tables from the same edge rows."""
+    rows = np.nonzero(cell_convex >= 0)[0]
+    Cv = rows.size
+    sites = np.zeros((Cv, 2), dtype=np.float64)
+    cell = np.zeros(Cv, dtype=np.int64)
+    for u in rows:
+        r = int(cell_convex[u])
+        k = int(epc[u])
+        # one closed convex ring: the edge 'a' endpoints enumerate the
+        # ring's vertices exactly once
+        sites[r] = cell_edges[u, :k, 0:2].astype(np.float64).mean(axis=0)
+        cell[r] = uniq[u]
+    adj, method = _voronoi_adjacency(sites)
+    return VoronoiTables(
+        sites=sites, adjacency=adj,
+        geom=np.asarray(convex_geom, dtype=np.int32), cell=cell,
+        shift=np.asarray(shift, dtype=np.float64), method=method,
+    )
 
 
 def _build_convex_tables(

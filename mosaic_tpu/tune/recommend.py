@@ -45,6 +45,15 @@ OVERLAY_BORDER_SHARE = 0.5
 #: both exact and cheaper
 OVERLAY_DEVICE_CANDIDATES = 4096
 
+#: convex-candidate share above which the KNN Voronoi fast path pays: the
+#: one-shot cover dispatch needs the Voronoi walk's strict-descent
+#: guarantee, which only convex chip sites give, so its fallback-to-ring
+#: fraction tracks (1 - convex share). The KNN_r19 bench lane measured the
+#: Voronoi lane well above parity on an all-convex fixture (see
+#: ``detail.voronoi_speedup_vs_ring``); at half-convex the saved ring
+#: iterations still dominate the wasted walk on the non-convex half
+KNN_CONVEX_SHARE = 0.5
+
 
 @dataclasses.dataclass
 class TuningProfile:
@@ -64,6 +73,7 @@ class TuningProfile:
     raster_tile: "tuple | None" = None
     zonal_lane: "str | None" = None
     overlay_lane: "str | None" = None
+    knn_lane: "str | None" = None
     rationale: list = dataclasses.field(default_factory=list)
     source: dict = dataclasses.field(default_factory=dict)
 
@@ -119,6 +129,7 @@ def load_priors(root: "str | Path | None" = None) -> dict:
         "STREAM_*.json",
         "RASTER_*.json",
         "OVERLAY_*.json",
+        "KNN_*.json",
     ):
         for path in sorted(root.glob(pattern)):
             try:
@@ -207,6 +218,25 @@ def _recommend(profile: WorkloadProfile, priors: dict) -> TuningProfile:
                  "threshold": ADAPTIVE_DENSE_SHARE},
             )
 
+    if profile.kind == "points" and shares:
+        speedup, artifact = _knn_lane_prior(priors)
+        convex = float(shares.get("convex", 0.0))
+        evidence = {
+            "convex": convex,
+            "threshold": KNN_CONVEX_SHARE,
+            "artifact": artifact,
+            "voronoi_speedup_vs_ring": speedup,
+        }
+        if convex > KNN_CONVEX_SHARE and (speedup is None or speedup >= 1.0):
+            # mostly-convex candidates: the Voronoi walk's one-shot cover
+            # replaces the iterative ring loop, and the committed bench
+            # did not measure it losing to ring on this hardware
+            set_knob("knn_lane", "voronoi",
+                     "convex-share-voronoi-lane", evidence)
+        else:
+            set_knob("knn_lane", "ring",
+                     "mixed-share-ring-lane", evidence)
+
     n_total = profile.n_total or profile.n_sampled
     if profile.kind == "points" and n_total:
         # batch at a pow2 that amortizes dispatch overhead but keeps the
@@ -289,6 +319,26 @@ def _overlay_lane_prior(priors: dict):
         if not isinstance(detail, dict):
             continue
         s = detail.get("speedup_vs_host")
+        if isinstance(s, (int, float)):
+            # newest round wins (names sort by round suffix)
+            speedup, artifact = float(s), name
+    return speedup, artifact
+
+
+def _knn_lane_prior(priors: dict):
+    """The committed KNN bench's Voronoi-vs-ring measurement, when one
+    exists: ``(voronoi_speedup_vs_ring, artifact)``. A measured speedup
+    < 1.0 means the one-shot Voronoi cover lost to iterative ring
+    expansion on this hardware, so the router keeps the ring lane even
+    for convex-dominated candidates."""
+    speedup, artifact = None, None
+    for name, art in sorted(priors.get("artifacts", {}).items()):
+        if not name.startswith("KNN_") or not isinstance(art, dict):
+            continue
+        detail = art.get("detail")
+        if not isinstance(detail, dict):
+            continue
+        s = detail.get("voronoi_speedup_vs_ring")
         if isinstance(s, (int, float)):
             # newest round wins (names sort by round suffix)
             speedup, artifact = float(s), name

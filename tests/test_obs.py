@@ -641,7 +641,7 @@ class TestPerfGate:
                 "recheck_narrow", "quarantine_stage", "snapshot_saved",
                 "probe_stage", "raster_stage", "multichip_stage",
                 "expr_stage", "tune_stage", "router_stage",
-                "overlay_stage", "epoch_stage",
+                "overlay_stage", "epoch_stage", "knn_stage",
             ), key
 
 

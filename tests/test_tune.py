@@ -182,6 +182,7 @@ class TestResolveKnob:
             "lookup": "gather", "batch_size": 2048, "bucket_min": 128,
             "bucket_max": 1024, "stream_window": 6, "stream_pipeline": True,
             "raster_tile": (64, 64), "zonal_lane": "tiled",
+            "knn_lane": "voronoi",
         }
         env_values = {
             "probe": ("MOSAIC_TUNE_PROBE", "scatter", "scatter"),
@@ -194,6 +195,7 @@ class TestResolveKnob:
             "stream_pipeline": ("MOSAIC_STREAM_PIPELINE", "1", True),
             "raster_tile": ("MOSAIC_RASTER_TILE", "32x32", (32, 32)),
             "zonal_lane": ("MOSAIC_RASTER_LANE", "fold", "fold"),
+            "knn_lane": ("MOSAIC_TUNE_KNN_LANE", "ring", "ring"),
         }
         assert set(KNOBS) == set(profile_values)
         prof = TuningProfile(**profile_values)

@@ -45,6 +45,7 @@ PATTERNS = (
     "SERVE_TENANT_r*.json",
     "OVERLAY_r*.json",
     "EPOCH_r*.json",
+    "KNN_r*.json",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)$")

@@ -386,6 +386,72 @@ def drive_knn():
     return one_shot("knn.pair_distances", run, same)
 
 
+# ---------------------------------------------------------- knn serving
+
+
+def knn_ctx():
+    """A warmed ring-lane KNN frontend over dense grid-indexed squares
+    plus a fixed query batch — shared by the three knn.* site drivers
+    (warmup is the expensive part; the clean answer is memoized too)."""
+
+    def build():
+        import numpy as np
+
+        from mosaic_tpu import functions as F
+        from mosaic_tpu.knn import KNNFrontend, build_knn_index
+
+        rng = np.random.default_rng(23)
+        n = 80
+        cx = rng.uniform(BBOX[0], BBOX[2], n)
+        cy = rng.uniform(BBOX[1], BBOX[3], n)
+        s = rng.uniform(0.5, 1.5, n)
+        cand = F.st_geomfromwkt(np.array([
+            f"POLYGON(({x} {y}, {x + w} {y}, {x + w} {y + w},"
+            f" {x} {y + w}, {x} {y}))"
+            for x, y, w in zip(cx, cy, s)
+        ]))
+        kx = build_knn_index(cand, index_system=grid(), resolution=RES)
+        fe = KNNFrontend(kx, lane="ring")
+        fe.warmup()
+        lo = np.array([cx.min(), cy.min()])
+        hi = np.array([cx.max(), cy.max()])
+        q = lo + rng.uniform(0.1, 0.9, (6, 2)) * (hi - lo)
+        return fe, q
+
+    return memo("knn_ctx", build)
+
+
+def _knn_site(site):
+    fe, q = knn_ctx()
+
+    def run():
+        out, _ = fe.dispatch(q, 2)
+        return out
+
+    clean = memo("knn_clean", run)
+    r = one_shot(site, run, arr_same, clean=clean)
+    # the frontend must keep serving exactly after the fault
+    if not arr_same(run(), clean):
+        raise ChaosMiss(f"{site}: frontend did not recover after the "
+                        "injected fault")
+    return r
+
+
+@driver("knn.expand")
+def drive_knn_expand():
+    return _knn_site("knn.expand")
+
+
+@driver("knn.distance")
+def drive_knn_distance():
+    return _knn_site("knn.distance")
+
+
+@driver("knn.scatter")
+def drive_knn_scatter():
+    return _knn_site("knn.scatter")
+
+
 # --------------------------------------------------------- expr / raster
 
 
