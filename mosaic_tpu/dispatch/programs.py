@@ -153,6 +153,9 @@ class ProgramStore:
             "key": key,
             "sha256": hashlib.sha256(payload).hexdigest(),
             "env": backend_fingerprint(),
+            # which process exported this program — fleet_report joins
+            # sidecars to trails by this id across a restart storm
+            "incarnation": _telemetry.INCARNATION,
             "meta": meta or {},
         }
         tmp = json_path + ".tmp"

@@ -28,20 +28,46 @@ backward-compatible with) `runtime/telemetry.py`'s flat event trail:
 - **timeline attribution** (`obs/timeline.py`) — interval
   reconstruction from span ``start_mono``/``seconds``, per-track
   gap/overlap, and the priority sweep that classifies lost wall time
-  into {transfer, compile, queue_wait, host_callback, device, idle}.
+  into {transfer, compile, queue_wait, host_callback, device, idle};
+- **SLO monitor** (`obs/slo.py`) — the live ops plane's alerting core:
+  sliding-window burn-rate evaluation over registered SLO specs
+  (default set gated on ``MOSAIC_SLO_ENABLE``), breaches emitted as
+  typed ``slo_violation`` events that trip the flight recorder;
+- **health** (`obs/health.py`) — per-subsystem and per-tenant
+  three-state health machine (healthy/degrading/unhealthy with
+  hysteresis) over shed/retry/stall/degradation counters, exported as
+  the ``obs.health{scope}`` gauge and consumed by the serve router's
+  eviction order;
+- **ops server** (`obs/ops_server.py`) — opt-in (``MOSAIC_OPS_PORT``)
+  stdlib-HTTP pull endpoint serving Prometheus text plus the
+  health/SLO snapshots.
 
 Tools: `tools/trace_report.py` renders/diffs per-stage latency
 breakdowns from trails; `tools/stall_report.py` decomposes a window of
 wall time into stall classes; `tools/perf_gate.py` is the CI
 regression gate over committed stage-share goldens
-(`tests/goldens/perf_gate.json`).
+(`tests/goldens/perf_gate.json`); `tools/fleet_report.py` stitches many
+processes' trails into one incarnation-linked timeline;
+`tools/doctor.py` runs the known-failure-signature checks over
+committed artifacts and trails.
 
-Importing this package registers the tracer, the metric bridge, and
-the flight recorder with `runtime/telemetry.py`; until then the
-runtime pays nothing for any of them.
+Importing this package registers the tracer, the metric bridge, the
+flight recorder, the SLO monitor, and the health monitor with
+`runtime/telemetry.py` (and starts the ops server iff
+``MOSAIC_OPS_PORT`` is set); until then the runtime pays nothing for
+any of them.
 """
 
-from . import export, metrics, recorder, timeline, trace
+from . import (
+    export,
+    health,
+    metrics,
+    ops_server,
+    recorder,
+    slo,
+    timeline,
+    trace,
+)
 from .export import (
     chrome_trace,
     prometheus_text,
@@ -61,7 +87,10 @@ from .metrics import (
     histogram,
     snapshot,
 )
+from .health import HealthMonitor
+from .ops_server import OpsServer
 from .recorder import RECORDER, FlightRecorder
+from .slo import SLOMonitor, SLOSpec, evaluate_trail
 from .trace import (
     Span,
     SpanContext,
@@ -73,11 +102,18 @@ from .trace import (
 
 metrics.install_bridge()
 recorder.install()
+slo.install()
+health.install()
+ops_server.maybe_start()
 
 __all__ = [
     "FlightRecorder",
+    "HealthMonitor",
+    "OpsServer",
     "RECORDER",
     "REGISTRY",
+    "SLOMonitor",
+    "SLOSpec",
     "Counter",
     "Gauge",
     "Histogram",
@@ -88,13 +124,17 @@ __all__ = [
     "chrome_trace",
     "counter",
     "current_context",
+    "evaluate_trail",
     "export",
     "gauge",
+    "health",
     "histogram",
     "metrics",
+    "ops_server",
     "prometheus_text",
     "read_trail",
     "recorder",
+    "slo",
     "snapshot",
     "span",
     "start_span",

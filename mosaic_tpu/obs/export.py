@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 
+from ..runtime import telemetry as _telemetry
 from . import metrics as _metrics, timeline as _timeline
 
 #: fixed Perfetto rows for classified intervals — stable tids well
@@ -46,10 +47,27 @@ _SPAN_FIELDS = (
 )
 
 
-def write_jsonl(events, path: str) -> int:
-    """Write events as JSON Lines; returns the number written."""
+def write_jsonl(
+    events, path: str, *, stamp_incarnation: bool = True
+) -> int:
+    """Write events as JSON Lines; returns the number of lines written.
+
+    Unless ``stamp_incarnation=False`` (or the first event already IS an
+    incarnation meta row — e.g. re-writing a stitched fleet trail), the
+    trail opens with one ``event="incarnation"`` line carrying this
+    process's :data:`~mosaic_tpu.runtime.telemetry.INCARNATION` id and a
+    paired ``ts_mono``/``ts_epoch`` wall-clock anchor — the hook
+    `tools/fleet_report.py` uses to merge many processes' trails onto
+    one timeline.
+    """
     n = 0
     with open(path, "w") as f:
+        first = events[0] if isinstance(events, (list, tuple)) and events else None
+        if stamp_incarnation and not (
+            isinstance(first, dict) and first.get("event") == "incarnation"
+        ):
+            f.write(json.dumps(_telemetry.incarnation_event()) + "\n")
+            n += 1
         for e in events:
             f.write(json.dumps(e, default=repr) + "\n")
             n += 1

@@ -40,6 +40,18 @@ DEFAULT_BUCKETS = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+#: per-metric label-cardinality cap. Labels come from event fields —
+#: a tenant name, a shed reason — and one misbehaving caller (tenant
+#: ids minted per request) would otherwise grow a series map without
+#: bound inside a process-lifetime registry. At the cap, NEW label sets
+#: fold into the reserved overflow series below and one typed
+#: ``metric_series_overflow`` warning crosses the spine per metric.
+DEFAULT_MAX_SERIES = 256
+
+#: the reserved series overflowing label sets fold into —
+#: ``{overflow="true"}`` in the snapshot / Prometheus exposition
+OVERFLOW_KEY = (("overflow", "true"),)
+
 
 def _label_key(labels: dict) -> tuple:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
@@ -48,11 +60,39 @@ def _label_key(labels: dict) -> tuple:
 class _Metric:
     kind = ""
 
-    def __init__(self, name: str, help: str = ""):
+    def __init__(
+        self, name: str, help: str = "",
+        max_series: int = DEFAULT_MAX_SERIES,
+    ):
         self.name = name
         self.help = help
+        self.max_series = int(max_series)
         self._lock = threading.Lock()
         self._series: dict = {}
+        self._overflow_warned = False
+
+    def _key(self, labels: dict) -> tuple:
+        """The series key for a write, with the cardinality cap applied:
+        existing series always resolve to themselves; a NEW label set at
+        the cap resolves to :data:`OVERFLOW_KEY`. Caller holds ``_lock``
+        and must call :meth:`_warn_overflow` AFTER releasing it."""
+        key = _label_key(labels)
+        if key in self._series or len(self._series) < self.max_series:
+            return key
+        return OVERFLOW_KEY
+
+    def _warn_overflow(self, key: tuple) -> None:
+        """Emit the one-per-metric typed overflow warning. Called with
+        ``_lock`` RELEASED: record() re-enters the observer chain (the
+        bridge folds events back into metrics), and a non-reentrant lock
+        held across that chain would deadlock on self-referencing
+        metrics."""
+        if key is OVERFLOW_KEY and not self._overflow_warned:
+            self._overflow_warned = True
+            _telemetry.record(
+                "metric_series_overflow",
+                metric=self.name, max_series=self.max_series,
+            )
 
     def labels(self) -> list[dict]:
         """Every label set this metric has recorded under."""
@@ -77,9 +117,10 @@ class Counter(_Metric):
     kind = "counter"
 
     def inc(self, n: float = 1, **labels) -> None:
-        key = _label_key(labels)
         with self._lock:
+            key = self._key(labels)
             self._series[key] = self._series.get(key, 0) + n
+        self._warn_overflow(key)
 
     def value(self, **labels) -> float:
         return self._series.get(_label_key(labels), 0)
@@ -92,12 +133,15 @@ class Gauge(_Metric):
 
     def set(self, value: float, **labels) -> None:
         with self._lock:
-            self._series[_label_key(labels)] = float(value)
+            key = self._key(labels)
+            self._series[key] = float(value)
+        self._warn_overflow(key)
 
     def inc(self, n: float = 1, **labels) -> None:
-        key = _label_key(labels)
         with self._lock:
+            key = self._key(labels)
             self._series[key] = self._series.get(key, 0.0) + n
+        self._warn_overflow(key)
 
     def value(self, **labels) -> float:
         return self._series.get(_label_key(labels), 0.0)
@@ -112,8 +156,9 @@ class Histogram(_Metric):
 
     def __init__(
         self, name: str, help: str = "", buckets=DEFAULT_BUCKETS,
+        max_series: int = DEFAULT_MAX_SERIES,
     ):
-        super().__init__(name, help)
+        super().__init__(name, help, max_series=max_series)
         self.buckets = tuple(sorted(float(b) for b in buckets))
 
     def _new_series(self) -> dict:
@@ -126,14 +171,15 @@ class Histogram(_Metric):
     def observe(self, value: float, **labels) -> None:
         v = float(value)
         i = bisect.bisect_left(self.buckets, v)
-        key = _label_key(labels)
         with self._lock:
+            key = self._key(labels)
             s = self._series.get(key)
             if s is None:
                 s = self._series[key] = self._new_series()
             s["counts"][i] += 1
             s["sum"] += v
             s["count"] += 1
+        self._warn_overflow(key)
 
     def value(self, **labels) -> dict:
         s = self._series.get(_label_key(labels))
@@ -234,6 +280,12 @@ def _on_event(evt: dict) -> None:
         counter("faults.injected").inc(site=evt.get("site", ""))
     elif ev == "serve_shed":
         counter("serve.requests_shed").inc(reason=evt.get("reason", ""))
+    elif ev == "router_shed":
+        counter("serve.router_shed").inc(
+            tenant=evt.get("tenant", ""), reason=evt.get("reason", "")
+        )
+    elif ev == "slo_violation":
+        counter("obs.slo_violations").inc(slo=evt.get("slo", ""))
     elif ev == "serve_request":
         counter("serve.requests_completed").inc()
         if "seconds" in evt:

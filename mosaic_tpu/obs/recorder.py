@@ -42,8 +42,10 @@ DEFAULT_N = 4096
 
 #: events that auto-dump the ring — the telemetry names of the three
 #: typed failures (RetryExhausted / StalledDeviceError / DegradedResult)
+#: plus SLO burn-rate breaches (`obs/slo.py` emits ``slo_violation`` on
+#: the spine precisely so it rides this trigger like any typed failure)
 TRIGGER_EVENTS = frozenset({
-    "retry_exhausted", "watchdog_stall", "degraded",
+    "retry_exhausted", "watchdog_stall", "degraded", "slo_violation",
 })
 
 #: floor between auto-dump *file writes* — a systemic failure degrades
@@ -151,32 +153,52 @@ class FlightRecorder:
                 now - self._last_file_t >= self.min_dump_interval_s
             ):
                 self._last_file_t = now
-                path = os.path.join(
-                    out_dir,
-                    f"flight-{evt.get('seq', 0):010d}"
-                    f"-{evt['event']}.jsonl",
-                )
+                name = f"flight-{evt.get('seq', 0):010d}-{evt['event']}"
+                if evt.get("slo") is not None:
+                    # slo_violation dumps name the violated SLO and its
+                    # evaluation window, so a directory of dumps reads
+                    # as an incident log without opening any file
+                    name += (
+                        f"-{_safe(evt['slo'])}"
+                        f"-w{evt.get('window_s', 0):g}s"
+                    )
+                path = os.path.join(out_dir, name + ".jsonl")
                 try:
                     os.makedirs(out_dir, exist_ok=True)
                     _write_jsonl(snap, path)
                     self.last_dump_path = path
                 except OSError:
                     path = None
+            extra = (
+                {"slo": evt["slo"], "window_s": evt.get("window_s")}
+                if evt.get("slo") is not None else {}
+            )
             _telemetry.record(
                 "recorder_dump",
                 trigger=evt["event"],
                 trigger_seq=evt.get("seq"),
                 n_events=len(snap),
                 path=path,
+                **extra,
             )
         finally:
             self._in_dump = False
 
 
+def _safe(name) -> str:
+    """Filesystem-safe fragment of an SLO name for dump filenames."""
+    return "".join(
+        c if (c.isalnum() or c in "._-") else "_" for c in str(name)
+    )
+
+
 def _write_jsonl(events, path: str) -> None:
     # local writer, not export.write_jsonl: the recorder must stay
-    # importable below the exporters (no circular obs-internal deps)
+    # importable below the exporters (no circular obs-internal deps).
+    # Same header contract though: an incarnation meta line first, so
+    # fleet_report can stitch recorder dumps next to bench trails.
     with open(path, "w") as f:
+        f.write(json.dumps(_telemetry.incarnation_event()) + "\n")
         for e in events:
             f.write(json.dumps(e, default=repr) + "\n")
 

@@ -79,6 +79,9 @@ def save_snapshot(
         "version": VERSION,
         "step": int(step),
         "sha256": digest,
+        # which process wrote this snapshot — fleet_report joins
+        # sidecars to trails by this id when stitching a restart storm
+        "incarnation": telemetry.INCARNATION,
         "meta": dict(meta or {}),
     }
     tmp_json = json_path + ".tmp"

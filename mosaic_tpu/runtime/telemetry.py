@@ -29,6 +29,7 @@ import contextlib
 import itertools
 import logging
 import math
+import os
 import threading
 import time
 
@@ -38,6 +39,39 @@ _LOCAL = threading.local()
 #: GIL, so concurrent recorders (watchdog workers, stream threads) still
 #: get unique, strictly increasing numbers
 _SEQ = itertools.count()
+
+#: per-process incarnation id, minted once at import:
+#: ``<start-unix-seconds:8 hex>-<pid>-<random:6 hex>`` — the identity
+#: that stitches a fleet story back together. Every JSONL trail, flight-
+#: recorder dump, snapshot sidecar, and ProgramStore sidecar is stamped
+#: with it, so `tools/fleet_report.py` can merge the trails of a restart
+#: storm (N child processes, N incarnations) into one logical timeline.
+#: The leading hex timestamp makes incarnations of one host sort in
+#: start order; the random suffix disambiguates pid reuse.
+INCARNATION = (
+    f"{int(time.time()):08x}-{os.getpid()}-{os.urandom(3).hex()}"
+)
+
+
+def incarnation() -> str:
+    """This process's :data:`INCARNATION` id (stable for the process
+    lifetime; a forked/relaunched process mints its own)."""
+    return INCARNATION
+
+
+def incarnation_event() -> dict:
+    """One ``event="incarnation"`` meta dict anchoring this process to
+    the wall clock: ``ts_mono`` and ``ts_epoch`` are sampled together,
+    so a fleet reader can place any of this trail's monotonic stamps on
+    the shared wall-clock axis (``ts_epoch + (e.ts_mono - ts_mono)``)
+    — monotonic clocks are per-process and never comparable directly."""
+    return {
+        "event": "incarnation",
+        "incarnation": INCARNATION,
+        "pid": os.getpid(),
+        "ts_mono": round(time.monotonic(), 6),
+        "ts_epoch": round(time.time(), 6),
+    }
 
 #: the runtime event logger, resolved ONCE — ``utils.get_logger`` force-
 #: installs a handler at INFO, which made every record() format and emit

@@ -13,11 +13,13 @@ free, and the router folds it onto the obs spine
 
 Residency is bounded: at most ``max_resident`` engines (explicit arg >
 ``MOSAIC_SERVE_TENANTS`` env knob > 4) hold warmed cores at once.
-Registering or reviving a tenant past the bound evicts the
-least-recently-used tenant's engine under the ``router.evict``
-fault/watchdog site (cold — never-warmed — engines go first, matching
-`_CoreCache`'s occupancy-aware order); the evicted tenant stays
-registered and is revived transparently on its next submit. With a
+Registering or reviving a tenant past the bound evicts one resident
+tenant's engine under the ``router.evict`` fault/watchdog site —
+health-aware: unhealthy tenants (per `obs/health.py`'s per-tenant
+state machine) lose residency first, then cold — never-warmed —
+engines (matching `_CoreCache`'s occupancy-aware order), then LRU; the
+evicted tenant stays registered and is revived transparently on its
+next submit. With a
 :class:`~mosaic_tpu.dispatch.programs.ProgramStore` bound, a revival's
 warmup is an AOT load, not a compile storm — eviction costs
 milliseconds, which is what makes bounded residency viable at all.
@@ -40,6 +42,7 @@ import threading
 import time
 
 from ..dispatch import guarded_call, resolve_program_store
+from ..obs import health as _health
 from ..obs import metrics as _metrics
 from ..obs import trace as _trace
 from ..runtime import faults as _faults
@@ -110,6 +113,7 @@ class ServeRouter:
         default_deadline_s: float | None = 1.0,
         queue_capacity: int = 256,
         engine_defaults: dict | None = None,
+        health_monitor=None,
     ):
         self.index_system = index_system
         self.max_resident = resolve_max_resident(max_resident)
@@ -117,6 +121,11 @@ class ServeRouter:
         self.default_deadline_s = default_deadline_s
         self.queue_capacity = queue_capacity
         self.engine_defaults = dict(engine_defaults or {})
+        #: the health state machine consulted by the eviction order —
+        #: the process monitor unless a test injects its own
+        self.health_monitor = (
+            _health.MONITOR if health_monitor is None else health_monitor
+        )
         self._tenants: dict[str, _Tenant] = {}
         self._lock = threading.Lock()
         self._closed = False
@@ -214,8 +223,10 @@ class ServeRouter:
         return sum(1 for t in self._tenants.values() if t.engine is not None)
 
     def _eviction_victim(self, exclude: str) -> "_Tenant | None":
-        """Occupancy-aware LRU: among resident tenants, never-warmed
-        engines first (nothing of value to drop), then oldest
+        """Health-aware occupancy-aware LRU: among resident tenants,
+        sickest first (an unhealthy tenant's residency is the cheapest
+        thing the fleet can shed — it is mostly shedding anyway), then
+        never-warmed engines (nothing of value to drop), then oldest
         ``last_used``."""
         resident = [
             t for t in self._tenants.values()
@@ -223,9 +234,13 @@ class ServeRouter:
         ]
         if not resident:
             return None
+        rank = _health.RANK
+        state = self.health_monitor.tenant_state
         return min(
             resident,
-            key=lambda t: (t.engine.core.warmed, t.last_used),
+            key=lambda t: (
+                -rank[state(t.name)], t.engine.core.warmed, t.last_used,
+            ),
         )
 
     def _evict(self, t: _Tenant) -> None:
@@ -282,9 +297,10 @@ class ServeRouter:
             return engine.submit(points, deadline_s=deadline_s)
         except Overloaded as e:
             t.shed_admit += 1
-            _metrics.counter(
-                "serve.router_shed", "router-level per-tenant sheds",
-            ).inc(tenant=tenant, reason=e.reason)
+            # a typed EVENT, not a direct counter inc: the obs bridge
+            # folds it into serve.router_shed{tenant, reason}, and the
+            # SLO/health monitors see the same shed the metric counts
+            _telemetry.record("router_shed", tenant=tenant, reason=e.reason)
             raise
 
     def join(self, tenant, points, *, deadline_s=None, timeout=None):
@@ -318,9 +334,7 @@ class ServeRouter:
             return engine.submit_knn(points, k, deadline_s=deadline_s)
         except Overloaded as e:
             t.shed_admit += 1
-            _metrics.counter(
-                "serve.router_shed", "router-level per-tenant sheds",
-            ).inc(tenant=tenant, reason=e.reason)
+            _telemetry.record("router_shed", tenant=tenant, reason=e.reason)
             raise
 
     def join_knn(self, tenant, points, k, *, deadline_s=None, timeout=None):
@@ -428,6 +442,7 @@ class ServeRouter:
                     revivals=t.revivals,
                     epoch=t.epoch,
                     epoch_advances=t.epoch_advances,
+                    health=self.health_monitor.tenant_state(name),
                 )
                 per[name] = m
             return {

@@ -1,0 +1,211 @@
+"""Per-subsystem / per-tenant health state machine (PR 20).
+
+The acceptance contract of `mosaic_tpu/obs/health.py`:
+
+- healthy → degrading → unhealthy on the windowed bad fraction, with
+  hysteresis (clear only below ``clear_factor x`` the enter threshold);
+- below ``min_events`` the state HOLDS; an empty window decays healthy;
+- tenant scopes build from ``router_shed``/``router_stage`` events;
+- every transition emits one typed ``health_transition`` and updates
+  the ``obs.health{scope}`` gauge;
+- the ServeRouter's eviction order prefers unhealthy tenants over
+  warm/LRU considerations.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from mosaic_tpu.obs import health
+from mosaic_tpu.obs import metrics as obs_metrics
+from mosaic_tpu.runtime import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _quiesce_process_monitor():
+    """The process-wide monitor also watches the live spine; once other
+    suites have fed it, its piggybacked cadence evaluations can emit
+    their own ``health_transition`` inside these tests' captures (and
+    overwrite the ``obs.health`` gauge). Private monitors only."""
+    health.uninstall()
+    try:
+        yield
+    finally:
+        health.install()
+
+
+def _feed(m, event, n, t, **fields):
+    for _ in range(n):
+        m.observer({"event": event, "ts_mono": t, **fields})
+
+
+class TestStateMachine:
+    def test_shed_storm_goes_unhealthy_with_transition_event(self):
+        m = health.HealthMonitor(window_s=10.0)
+        with telemetry.capture() as events:
+            _feed(m, "serve_shed", 5, 100.0)
+            m.evaluate(100.0)
+        assert m.state("serve") == "unhealthy"
+        trans = [e for e in events if e["event"] == "health_transition"]
+        assert len(trans) == 1
+        assert trans[0]["scope"] == "serve"
+        assert trans[0]["prev"] == "healthy"
+        assert trans[0]["to"] == "unhealthy"
+        assert trans[0]["bad_ratio"] == 1.0
+        g = obs_metrics.gauge("obs.health")
+        assert g.value(scope="serve") == health.RANK["unhealthy"]
+
+    def test_hysteresis_clears_stepwise_below_half_threshold(self):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, "serve_shed", 5, 100.0)
+        m.evaluate(100.0)
+        assert m.state("serve") == "unhealthy"
+        # ratio 5/20 = 0.25 >= 0.5*unhealthy_ratio: still unhealthy
+        _feed(m, "serve_request", 15, 100.0)
+        m.evaluate(100.5)
+        assert m.state("serve") == "unhealthy"
+        # ratio 5/40 = 0.125 < 0.25 clear floor: down to degrading
+        # (still >= 0.05, the degrading clear floor)
+        _feed(m, "serve_request", 20, 100.0)
+        m.evaluate(101.0)
+        assert m.state("serve") == "degrading"
+        # ratio 5/120 < 0.05: all the way back to healthy
+        _feed(m, "serve_request", 80, 100.0)
+        m.evaluate(101.5)
+        assert m.state("serve") == "healthy"
+
+    def test_min_events_holds_state(self):
+        m = health.HealthMonitor(window_s=10.0, min_events=5)
+        _feed(m, "serve_shed", 2, 100.0)  # 100% bad but only 2 events
+        m.evaluate(100.0)
+        assert m.state("serve") == "healthy"
+
+    def test_empty_window_decays_to_healthy(self):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, "serve_shed", 5, 100.0)
+        m.evaluate(100.0)
+        assert m.state("serve") == "unhealthy"
+        with telemetry.capture() as events:
+            m.evaluate(500.0)  # storm long gone
+        assert m.state("serve") == "healthy"
+        (t,) = [e for e in events if e["event"] == "health_transition"]
+        assert t["prev"] == "unhealthy" and t["to"] == "healthy"
+
+    def test_degrading_band(self):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, "serve_request", 8, 100.0)
+        _feed(m, "serve_shed", 2, 100.0)  # ratio 0.2: degrading band
+        m.evaluate(100.0)
+        assert m.state("serve") == "degrading"
+
+    def test_unknown_scope_reads_healthy(self):
+        m = health.HealthMonitor()
+        assert m.state("nonesuch") == "healthy"
+        assert m.tenant_state("ghost") == "healthy"
+
+
+class TestTenantScoping:
+    def test_router_events_build_tenant_scopes(self):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, "router_shed", 5, 100.0, tenant="noisy", reason="queue_full")
+        _feed(m, "router_stage", 20, 100.0, tenant="quiet", stage="admit")
+        m.evaluate(100.0)
+        assert m.tenant_state("noisy") == "unhealthy"
+        assert m.tenant_state("quiet") == "healthy"
+        # router_shed is also a serve-subsystem bad
+        assert m.state("serve") == "unhealthy"
+
+    def test_non_admit_router_stage_is_ignored(self):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, "router_stage", 5, 100.0, tenant="t", stage="revive")
+        m.evaluate(100.0)
+        assert "tenant:t" not in m.snapshot(100.0)["scopes"]
+
+    def test_snapshot_shape(self):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, "serve_shed", 5, 100.0)
+        snap = m.snapshot(100.0)
+        assert snap["window_s"] == 10.0
+        s = snap["scopes"]["serve"]
+        assert s["state"] == "unhealthy" and s["rank"] == 2
+        assert s["events"] == 5 and s["transitions"] == 1
+
+
+class TestSubsystemRouting:
+    @pytest.mark.parametrize("event,scope", [
+        ("transient_retry", "runtime"),
+        ("retry_exhausted", "runtime"),
+        ("watchdog_stall", "runtime"),
+        ("capacity_overflow", "stream"),
+        ("stream_quarantine", "stream"),
+    ])
+    def test_bad_events_route_to_their_subsystem(self, event, scope):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, event, 5, 100.0)
+        m.evaluate(100.0)
+        assert m.state(scope) == "unhealthy"
+
+    def test_stream_stage_is_a_stream_good(self):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, "capacity_overflow", 2, 100.0)
+        _feed(m, "stream_stage", 48, 100.0, stage="join_loop")
+        m.evaluate(100.0)
+        assert m.state("stream") == "healthy"  # ratio 0.04 < 0.10
+
+
+class TestRouterEviction:
+    """The router's eviction key consumes tenant health: sickest first,
+    then cold engines, then LRU — probed against the real
+    ``ServeRouter._eviction_victim`` with duck-typed tenants."""
+
+    @staticmethod
+    def _tenant(name, warmed, last_used):
+        return SimpleNamespace(
+            name=name,
+            engine=SimpleNamespace(core=SimpleNamespace(warmed=warmed)),
+            last_used=last_used,
+        )
+
+    def _stub(self, tenants, monitor):
+        from mosaic_tpu.serve.router import ServeRouter
+
+        stub = SimpleNamespace(
+            _tenants={t.name: t for t in tenants},
+            health_monitor=monitor,
+        )
+        return lambda exclude: ServeRouter._eviction_victim(
+            stub, exclude=exclude
+        )
+
+    def test_unhealthy_tenant_is_evicted_first(self):
+        m = health.HealthMonitor(window_s=10.0)
+        _feed(m, "router_shed", 10, 100.0, tenant="sick")
+        m.evaluate(100.0)
+        assert m.tenant_state("sick") == "unhealthy"
+        # "sick" is warm and most-recently-used; "fresh" is cold and
+        # oldest — health outranks both signals
+        victim = self._stub([
+            self._tenant("sick", warmed=True, last_used=100.0),
+            self._tenant("fresh", warmed=False, last_used=1.0),
+        ], m)(exclude="incoming")
+        assert victim.name == "sick"
+
+    def test_healthy_fleet_falls_back_to_cold_then_lru(self):
+        m = health.HealthMonitor(window_s=10.0)
+        victim = self._stub([
+            self._tenant("warm_old", warmed=True, last_used=1.0),
+            self._tenant("cold_new", warmed=False, last_used=100.0),
+        ], m)(exclude="incoming")
+        assert victim.name == "cold_new"  # cold loses residency first
+        victim = self._stub([
+            self._tenant("warm_old", warmed=True, last_used=1.0),
+            self._tenant("warm_new", warmed=True, last_used=100.0),
+        ], m)(exclude="incoming")
+        assert victim.name == "warm_old"  # then LRU
+
+    def test_exclude_is_never_chosen(self):
+        m = health.HealthMonitor()
+        pick = self._stub(
+            [self._tenant("only", warmed=True, last_used=1.0)], m
+        )
+        assert pick(exclude="only") is None

@@ -229,8 +229,15 @@ class TestExport:
             _span_evt("s", "t1", "a1", None),
         ]
         p = tmp_path / "trail.jsonl"
-        assert obs.write_jsonl(events, str(p)) == 2
-        assert obs.read_trail(str(p)) == events
+        # +1: write_jsonl opens the trail with an incarnation meta line
+        # (the fleet_report stitching anchor)
+        assert obs.write_jsonl(events, str(p)) == 3
+        rows = obs.read_trail(str(p))
+        assert rows[0]["event"] == "incarnation"
+        assert rows[0]["incarnation"] == telemetry.INCARNATION
+        assert rows[1:] == events
+        # an already-stamped trail is NOT double-stamped on re-write
+        assert obs.write_jsonl(rows, str(p)) == 3
 
     def test_read_trail_accepts_bench_artifact(self, tmp_path):
         stages = [{"event": "stream_stage", "stage": "x", "seconds": 1.0}]
@@ -642,6 +649,7 @@ class TestPerfGate:
                 "probe_stage", "raster_stage", "multichip_stage",
                 "expr_stage", "tune_stage", "router_stage",
                 "overlay_stage", "epoch_stage", "knn_stage",
+                "ops_stage",
             ), key
 
 

@@ -1,0 +1,518 @@
+"""Live ops plane acceptance (PR 20): incarnation stitching, the fleet
+report, the doctor CLI, the ops pull endpoint, the metric cardinality
+cap, and the pinned observer-overhead budget.
+
+The cross-cutting contracts:
+
+- every trail opens with an incarnation header; `tools/fleet_report.py`
+  merges N processes' trails onto one wall-clock axis with restart-gap
+  links and cross-incarnation trace links;
+- `tools/doctor.py` runs the known failure signatures over any mix of
+  artifacts/trails/snapshots: green over clean evidence, red under an
+  injected regression, exit code to match;
+- the ops server answers /metrics, /health, /slo, / on an ephemeral
+  port with no new dependencies;
+- one misbehaving label producer cannot grow a metric's series map past
+  the cap (overflow series + ONE typed warning);
+- the whole ops plane (SLO + health observers on top of the standing
+  bridge + recorder) costs ≤ 1.15x the bare record() path.
+"""
+
+import http.client
+import json
+import re
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from mosaic_tpu import obs
+from mosaic_tpu.obs import health, metrics as obs_metrics, ops_server, slo
+from mosaic_tpu.runtime import telemetry
+
+REPO = Path(__file__).parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+
+# ----------------------------------------------------------- incarnation
+
+
+class TestIncarnation:
+    def test_format_and_stability(self):
+        inc = telemetry.incarnation()
+        assert re.fullmatch(r"[0-9a-f]{8}-\d+-[0-9a-f]{6}", inc)
+        assert inc == telemetry.INCARNATION == telemetry.incarnation()
+
+    def test_incarnation_event_pairs_the_clocks(self):
+        e = telemetry.incarnation_event()
+        assert e["event"] == "incarnation"
+        assert e["incarnation"] == telemetry.INCARNATION
+        assert isinstance(e["ts_mono"], float)
+        assert isinstance(e["ts_epoch"], float)
+        # the pair is sampled together: epoch-mono offset is stable
+        # within sampling noise between two anchor events
+        e2 = telemetry.incarnation_event()
+        off1 = e["ts_epoch"] - e["ts_mono"]
+        off2 = e2["ts_epoch"] - e2["ts_mono"]
+        assert abs(off1 - off2) < 0.05
+
+
+# --------------------------------------------------------- fleet stitch
+
+
+def _write_trail(path, inc, mono0, epoch0, n, trace=None, pid=1):
+    rows = [{
+        "event": "incarnation", "incarnation": inc, "pid": pid,
+        "ts_mono": mono0, "ts_epoch": epoch0,
+    }]
+    for i in range(n):
+        e = {
+            "event": "serve_request", "seq": i,
+            "ts_mono": round(mono0 + i * 0.1, 6), "seconds": 0.01,
+        }
+        if trace:
+            e["trace_id"] = trace
+        rows.append(e)
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(path)
+
+
+class TestFleetStitch:
+    def test_two_incarnations_one_wall_axis(self, tmp_path):
+        import fleet_report
+
+        # two processes with WILDLY different monotonic bases whose
+        # wall-clock anchors interleave them 5 s apart
+        a = _write_trail(tmp_path / "a.jsonl", "inc-a", 100.0,
+                         1000.0, 5, trace="t-shared")
+        b = _write_trail(tmp_path / "b.jsonl", "inc-b", 90000.0,
+                         1005.0, 5, trace="t-shared", pid=2)
+        events, summary = fleet_report.stitch([a, b])
+        assert len(events) == 10  # headers dropped from the merge
+        assert all("incarnation" in e and "ts_wall" in e for e in events)
+        # merged order is wall-clock order: all of a, then all of b
+        assert [e["incarnation"] for e in events] == ["inc-a"] * 5 + ["inc-b"] * 5
+        walls = [e["ts_wall"] for e in events]
+        assert walls == sorted(walls)
+        assert walls[0] == pytest.approx(1000.0)
+        assert walls[5] == pytest.approx(1005.0)
+        chain = summary["chain"]
+        assert [c["incarnation"] for c in chain] == ["inc-a", "inc-b"]
+        assert "prev" not in chain[0]
+        assert chain[1]["prev"] == "inc-a"
+        # dark gap: a's last event at 1000.4, b starts at 1005.0
+        assert chain[1]["gap_s"] == pytest.approx(4.6)
+        # the shared trace id links the incarnations
+        assert summary["cross_incarnation_traces"] == {
+            "t-shared": ["inc-a", "inc-b"],
+        }
+
+    def test_headerless_trail_gets_synthetic_incarnation(self, tmp_path):
+        import fleet_report
+
+        p = tmp_path / "legacy.jsonl"
+        rows = [
+            {"event": "serve_request", "seq": i, "ts_mono": 50.0 + i}
+            for i in range(3)
+        ]
+        p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        events, summary = fleet_report.stitch([str(p)])
+        assert len(events) == 3
+        assert all(e["incarnation"] == "file:legacy" for e in events)
+        info = summary["incarnations"]["file:legacy"]
+        assert info["synthetic"] is True
+
+    def test_fleet_report_cli_writes_mergeable_trail(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        import fleet_report
+
+        a = _write_trail(tmp_path / "a.jsonl", "inc-a", 0.0, 1000.0, 3)
+        b = _write_trail(tmp_path / "b.jsonl", "inc-b", 0.0, 1010.0, 3)
+        out = str(tmp_path / "merged.jsonl")
+        monkeypatch.setattr(
+            sys, "argv", ["fleet_report.py", a, b, "--out", out]
+        )
+        fleet_report.main()
+        rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rep["metric"] == "fleet_report"
+        assert rep["incarnations"] == 2 and rep["events"] == 6
+        merged = obs.read_trail(out)
+        assert len(merged) == 6  # multi-incarnation: no new header
+        assert merged[0]["incarnation"] == "inc-a"
+
+    def test_trace_report_fleet_mode(self, tmp_path, monkeypatch, capsys):
+        import trace_report
+
+        a = _write_trail(tmp_path / "a.jsonl", "inc-a", 0.0, 1000.0, 4)
+        b = _write_trail(tmp_path / "b.jsonl", "inc-b", 0.0, 1010.0, 4)
+        monkeypatch.setattr(
+            sys, "argv", ["trace_report.py", "--fleet", a, b]
+        )
+        trace_report.main()
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["metric"] == "trace_report"
+        assert out["fleet"]["incarnations"] == 2
+        assert out["fleet"]["chain"][1]["prev"] == "inc-a"
+        # the stage breakdown still works over the merged events
+        assert out["stages"]["serve_request"]["count"] == 8
+
+    def test_multiple_trails_without_fleet_flag_error(
+        self, tmp_path, monkeypatch
+    ):
+        import trace_report
+
+        a = _write_trail(tmp_path / "a.jsonl", "inc-a", 0.0, 1000.0, 1)
+        b = _write_trail(tmp_path / "b.jsonl", "inc-b", 0.0, 1001.0, 1)
+        monkeypatch.setattr(sys, "argv", ["trace_report.py", a, b])
+        with pytest.raises(SystemExit):
+            trace_report.main()
+
+
+# --------------------------------------------------------------- doctor
+
+
+def _artifact(tmp_path, name, detail):
+    p = tmp_path / name
+    p.write_text(json.dumps({
+        "metric": "m", "value": 1.0, "unit": "x", "detail": detail,
+    }) + "\n")
+    return str(p)
+
+
+def _trail_file(tmp_path, name, events):
+    p = tmp_path / name
+    p.write_text("".join(json.dumps(e) + "\n" for e in events))
+    return str(p)
+
+
+class TestDoctor:
+    def test_green_over_clean_evidence(self, tmp_path):
+        import doctor
+
+        art = _artifact(tmp_path, "clean.json", {
+            "cold_compiles": 0, "snapshot_overlap_fraction": 0.96,
+        })
+        trail = _trail_file(tmp_path, "clean.jsonl", [
+            {"event": "incarnation", "incarnation": "x", "ts_mono": 0.0,
+             "ts_epoch": 0.0},
+            {"event": "serve_request", "seq": 1, "seconds": 0.01,
+             "ts_mono": 1.0},
+        ])
+        report = doctor.diagnose([art, trail])
+        assert report["status"] == "green"
+        assert report["red_checks"] == []
+        assert report["inputs"]["by_kind"] == {"artifact": 1, "trail": 1}
+
+    def test_red_on_cold_compile_regression(self, tmp_path):
+        import doctor
+
+        art = _artifact(tmp_path, "bad.json", {
+            "relaunch": {"relaunch_cold_compiles": 3},
+        })
+        report = doctor.diagnose([art])
+        assert report["status"] == "red"
+        assert report["red_checks"] == ["cold_compiles"]
+        (f,) = next(
+            c for c in report["checks"] if c["check"] == "cold_compiles"
+        )["findings"]
+        assert f["count"] == 3 and "relaunch_cold_compiles" in f["where"]
+
+    def test_red_on_serve_compile_in_trail(self, tmp_path):
+        import doctor
+
+        trail = _trail_file(tmp_path, "t.jsonl", [
+            {"event": "serve_request", "seq": 1, "ts_mono": 1.0},
+            {"event": "serve_compile", "seq": 2, "ts_mono": 2.0},
+        ])
+        report = doctor.diagnose([trail])
+        assert "cold_compiles" in report["red_checks"]
+
+    def test_red_on_low_snapshot_overlap(self, tmp_path):
+        import doctor
+
+        art = _artifact(tmp_path, "o.json", {
+            "snapshot_overlap_fraction": 0.3,
+        })
+        assert doctor.diagnose([art])["red_checks"] == ["snapshot_overlap"]
+
+    def test_red_on_slo_violation_in_trail_and_artifact(self, tmp_path):
+        import doctor
+
+        trail = _trail_file(tmp_path, "v.jsonl", [
+            {"event": "slo_violation", "slo": "serve.shed", "seq": 1,
+             "burn_rate": 10.0, "window_s": 60.0, "ts_mono": 1.0},
+            {"event": "serve_request", "seq": 2, "ts_mono": 2.0},
+        ])
+        art = _artifact(tmp_path, "slo.json", {
+            "slo": {"breached": ["serve.latency"], "ok": False},
+        })
+        report = doctor.diagnose([trail, art])
+        assert report["red_checks"] == ["burn_rate"]
+        findings = next(
+            c for c in report["checks"] if c["check"] == "burn_rate"
+        )["findings"]
+        assert {f["slo"] for f in findings} == {
+            "serve.shed", "serve.latency",
+        }
+
+    def test_red_on_shed_imbalance_in_trail_only(self, tmp_path):
+        import doctor
+
+        noisy = [
+            {"event": "router_shed", "tenant": "hog", "seq": i,
+             "ts_mono": float(i)}
+            for i in range(60)
+        ] + [
+            {"event": "router_shed", "tenant": "victim", "seq": 99,
+             "ts_mono": 99.0},
+        ]
+        trail = _trail_file(tmp_path, "shed.jsonl", noisy)
+        assert doctor.diagnose([trail])["red_checks"] == ["shed_imbalance"]
+        # the SAME evidence inside a bench artifact is excluded on
+        # purpose (A/B benches shed on purpose)
+        art = _artifact(tmp_path, "ab.json", {"trail": noisy})
+        # an artifact's embedded trail reads as kind=artifact -> the
+        # imbalance check skips it
+        assert doctor.diagnose([art])["status"] == "green"
+
+    def test_red_on_cache_thrash_stats(self, tmp_path):
+        import doctor
+
+        trail = _trail_file(tmp_path, "c.jsonl", [
+            {"event": "dispatch_cache_stats", "seq": 1, "ts_mono": 1.0,
+             "lowered": {"hits": 10, "misses": 500, "maxsize": 64,
+                         "currsize": 64}},
+            {"event": "serve_request", "seq": 2, "ts_mono": 2.0},
+        ])
+        report = doctor.diagnose([trail])
+        assert report["red_checks"] == ["cache_thrash"]
+
+    def test_ops_snapshot_breach_is_red(self, tmp_path):
+        import doctor
+
+        p = tmp_path / "ops.json"
+        p.write_text(json.dumps({
+            "incarnation": "x", "pid": 1, "metrics": {},
+            "health": {"window_s": 60, "scopes": {}},
+            "slo": {"slos": {"serve.shed": {
+                "breached": True, "burn_short": 12.0,
+            }}},
+        }) + "\n")
+        report = doctor.diagnose([str(p)])
+        assert report["inputs"]["by_kind"] == {"ops": 1}
+        assert report["red_checks"] == ["burn_rate"]
+
+    def test_cli_exit_codes_and_last_line_json(
+        self, tmp_path, monkeypatch, capsys
+    ):
+        import doctor
+
+        good = _artifact(tmp_path, "good.json", {"cold_compiles": 0})
+        monkeypatch.setattr(sys, "argv", ["doctor.py", good])
+        assert doctor.main() == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["metric"] == "doctor" and out["status"] == "green"
+        bad = _artifact(tmp_path, "bad.json", {"cold_compiles": 7})
+        trail_out = str(tmp_path / "doc.jsonl")
+        monkeypatch.setattr(
+            sys, "argv", ["doctor.py", bad, "--trail", trail_out]
+        )
+        assert doctor.main() == 1
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["status"] == "red"
+        # the doctor's own work rode the spine: ops_stage events in
+        # the exported trail (perf_gate gates them like any stage)
+        rows = obs.read_trail(trail_out)
+        stages = {
+            e.get("stage") for e in rows if e.get("event") == "ops_stage"
+        }
+        assert {"scan", "checks"} <= stages
+
+    def test_committed_artifacts_are_green(self):
+        """The acceptance lane: the doctor must be green over the
+        repo's own committed evidence."""
+        import doctor
+
+        paths = [
+            str(REPO / name) for name in (
+                "SERVE_TENANT_r16.json", "SERVE_RESTART_r16.json",
+                "STREAM_CPU_r14.json", "KNN_r19.json", "EPOCH_r18.json",
+                "OVERLAY_r17.json", "OPS_r20.json",
+            ) if (REPO / name).exists()
+        ]
+        assert len(paths) >= 5, "committed artifacts went missing"
+        report = doctor.diagnose(paths)
+        assert report["status"] == "green", report["checks"]
+
+
+# ------------------------------------------------------------ ops server
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.getheader("Content-Type"), resp.read()
+    finally:
+        conn.close()
+
+
+class TestOpsServer:
+    def test_endpoints_serve_the_ops_plane(self):
+        with ops_server.OpsServer(0) as srv:
+            assert srv.port > 0
+            status, ctype, body = _get(srv.port, "/metrics")
+            assert status == 200 and "text/plain" in ctype
+            assert b"# TYPE" in body
+            status, ctype, body = _get(srv.port, "/health")
+            doc = json.loads(body)
+            assert status == 200 and "scopes" in doc
+            status, ctype, body = _get(srv.port, "/slo")
+            doc = json.loads(body)
+            assert status == 200 and "burn_threshold" in doc
+            status, ctype, body = _get(srv.port, "/")
+            doc = json.loads(body)
+            assert doc["incarnation"] == telemetry.INCARNATION
+            assert {"metrics", "health", "slo", "pid"} <= set(doc)
+            status, _, _ = _get(srv.port, "/nonesuch")
+            assert status == 404
+
+    def test_start_records_typed_event_and_stop_releases(self):
+        with telemetry.capture() as events:
+            srv = ops_server.OpsServer(0).start()
+            port = srv.port
+            srv.stop()
+        started = [e for e in events if e["event"] == "ops_server_started"]
+        assert len(started) == 1 and started[0]["port"] == port
+        # the port is actually released: rebinding succeeds
+        srv2 = ops_server.OpsServer(port).start()
+        srv2.stop()
+
+    def test_maybe_start_is_env_gated(self, monkeypatch):
+        monkeypatch.delenv("MOSAIC_OPS_PORT", raising=False)
+        assert ops_server.maybe_start() is None
+        monkeypatch.setenv("MOSAIC_OPS_PORT", "not-a-port")
+        assert ops_server.maybe_start() is None
+        monkeypatch.setenv("MOSAIC_OPS_PORT", "0")
+        try:
+            srv = ops_server.maybe_start()
+            assert srv is not None and srv.port > 0
+            # idempotent: second call returns the same server
+            assert ops_server.maybe_start() is srv
+        finally:
+            ops_server.stop()
+
+    def test_bind_failure_records_error_not_raise(self, monkeypatch):
+        blocker = ops_server.OpsServer(0).start()
+        try:
+            monkeypatch.setenv("MOSAIC_OPS_PORT", str(blocker.port))
+            with telemetry.capture() as events:
+                assert ops_server.maybe_start() is None
+            errs = [e for e in events if e["event"] == "ops_server_error"]
+            assert len(errs) == 1 and "error" in errs[0]
+        finally:
+            blocker.stop()
+            ops_server.stop()
+
+
+# ------------------------------------------------------ cardinality cap
+
+
+class TestCardinalityCap:
+    def test_counter_series_bounded_with_overflow_fold(self):
+        c = obs_metrics.Counter("cap.unit_counter", max_series=8)
+        with telemetry.capture() as events:
+            for i in range(100):
+                c.inc(tenant=f"t{i:03d}")
+        # 8 real series + the reserved overflow series
+        assert len(c._series) == 9
+        assert c._series[obs_metrics.OVERFLOW_KEY] == 92
+        # exactly ONE typed warning crossed the spine
+        warns = [
+            e for e in events if e["event"] == "metric_series_overflow"
+        ]
+        assert len(warns) == 1
+        assert warns[0]["metric"] == "cap.unit_counter"
+        assert warns[0]["max_series"] == 8
+
+    def test_existing_series_still_write_at_the_cap(self):
+        c = obs_metrics.Counter("cap.unit_existing", max_series=4)
+        for i in range(4):
+            c.inc(tenant=f"t{i}")
+        c.inc(5, tenant="t0")  # pre-existing series: not folded
+        assert c.value(tenant="t0") == 6
+        c.inc(tenant="t999")  # new series at the cap: folded
+        assert c.value(tenant="t999") == 0
+        assert c._series[obs_metrics.OVERFLOW_KEY] == 1
+
+    def test_gauge_and_histogram_respect_the_cap(self):
+        g = obs_metrics.Gauge("cap.unit_gauge", max_series=2)
+        for i in range(10):
+            g.set(float(i), scope=f"s{i}")
+        assert len(g._series) == 3
+        h = obs_metrics.Histogram(
+            "cap.unit_hist", buckets=(1.0,), max_series=2
+        )
+        for i in range(10):
+            h.observe(0.5, site=f"x{i}")
+        assert len(h._series) == 3
+        snap = h.snapshot()
+        overflow = next(
+            s for s in snap["series"]
+            if s["labels"] == {"overflow": "true"}
+        )
+        assert overflow["value"]["count"] == 8
+
+    def test_overflow_series_renders_in_prometheus_text(self):
+        c = obs_metrics.Counter("cap.unit_prom", max_series=1)
+        c.inc(tenant="a")
+        c.inc(tenant="b")
+        text = obs.prometheus_text({"cap.unit_prom": c.snapshot()})
+        assert 'cap_unit_prom{overflow="true"} 1' in text
+
+
+# ------------------------------------------------------ overhead budget
+
+
+def test_ops_plane_overhead_within_budget():
+    """SLO + health observers on top of the standing plane (bridge +
+    recorder) hold installed record() to ≤ 1.15x.  A bare/installed
+    pair inside one round shares ambient load, so the min of per-round
+    ratios is the noise-robust estimator (a real 1.3x plane would show
+    it in every round; one quiet round proves the budget holds)."""
+    n = 20_000
+
+    def once() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            telemetry.record("serve_request", seconds=0.001)
+        return time.perf_counter() - t0
+
+    def measure() -> float:
+        # Shed scopes/series accumulated by earlier suites so the
+        # installed path measures the plane, not their leftovers.
+        health.MONITOR.reset()
+        slo.MONITOR.reset()
+        ratio = float("inf")
+        try:
+            for _ in range(12):
+                slo.uninstall()
+                health.uninstall()
+                bare = once()
+                slo.install()
+                health.install()
+                ratio = min(ratio, once() / bare)
+        finally:
+            slo.install()
+            health.install()
+        return ratio
+
+    ratio = measure()
+    if ratio > 1.15:
+        ratio = min(ratio, measure())
+    assert ratio <= 1.15, (
+        f"ops-plane overhead {ratio:.3f}x exceeds the 1.15x budget"
+    )

@@ -79,7 +79,9 @@ def test_dump_writes_a_readable_jsonl_trail(tmp_path):
     snap = r.dump(path)
     assert len(snap) == 2
     rows = export.read_trail(path)
-    assert [e["seq"] for e in rows] == [1, 2]
+    # dumps open with the incarnation header (fleet-stitchable)
+    assert rows[0]["event"] == "incarnation"
+    assert [e["seq"] for e in rows[1:]] == [1, 2]
 
 
 def test_auto_dump_fires_on_injected_retry_exhausted():
@@ -142,6 +144,71 @@ def test_auto_dump_file_writes_are_debounced(tmp_path, monkeypatch):
     # both triggers snapshot in memory; only the first hits the disk
     assert r.auto_dumps == 2
     assert len(list(tmp_path.iterdir())) == 1
+
+
+def test_slo_violation_triggers_dump_named_after_the_slo(
+    tmp_path, monkeypatch
+):
+    """An SLO burn-rate breach is a first-class dump trigger, and the
+    dump file names the violated SLO and its window — a directory of
+    dumps reads as an incident log without opening any file."""
+    import os
+
+    monkeypatch.setenv("MOSAIC_RECORDER_DIR", str(tmp_path))
+    r = recorder.FlightRecorder(maxlen=8)
+    r({"event": "serve_shed", "seq": 6, "reason": "deadline"})
+    r({
+        "event": "slo_violation", "seq": 7, "slo": "serve.shed",
+        "window_s": 60.0, "burn_rate": 10.0,
+    })
+    assert r.auto_dumps == 1
+    assert r.last_dump_path is not None
+    name = os.path.basename(r.last_dump_path)
+    assert "slo_violation" in name
+    assert "serve.shed" in name and "w60s" in name
+    # the evidence leading up to the breach is IN the snapshot
+    assert any(
+        e["event"] == "serve_shed" for e in r.last_dump
+    )
+
+
+def test_one_dump_per_breach_episode(tmp_path, monkeypatch):
+    """A breached SLO that stays breached emits ONE violation — so one
+    dump — until the burn clears below the hysteresis floor; the flap
+    back up is a NEW episode and a new dump."""
+    from mosaic_tpu.obs import slo as obs_slo
+
+    monkeypatch.setenv("MOSAIC_RECORDER_DIR", str(tmp_path))
+    r = recorder.FlightRecorder(maxlen=64)
+    telemetry.add_observer(r.observer)
+    try:
+        m = obs_slo.SLOMonitor(
+            short_window_s=10.0, long_window_s=10.0,
+        )
+        spec = m.register(obs_slo.SLOSpec(
+            name="unit.shed", kind="ratio", objective=0.95,
+            min_events=1,
+        ))
+        m.wire_good(spec, "unit_good")
+        m.wire_bad(spec, "unit_bad")
+        t0 = 1000.0
+        for i in range(10):
+            m._ingest(m._handlers["unit_bad"], {"event": "unit_bad"}, t0)
+        m.evaluate(t0)          # breach: one violation, one dump
+        m.evaluate(t0 + 0.1)    # still breached: no new violation
+        m.evaluate(t0 + 0.2)
+        assert r.auto_dumps == 1
+        # burn clears (window slides past the bad burst) -> re-arm
+        m.evaluate(t0 + 50.0)
+        for i in range(10):
+            m._ingest(
+                m._handlers["unit_bad"], {"event": "unit_bad"},
+                t0 + 100.0,
+            )
+        m.evaluate(t0 + 100.0)  # new episode, second dump
+        assert r.auto_dumps == 2
+    finally:
+        telemetry.remove_observer(r.observer)
 
 
 def test_recorder_dump_event_rides_the_spine():
@@ -254,5 +321,6 @@ def test_dump_is_json_serializable_with_hostile_payloads(tmp_path):
     path = str(tmp_path / "h.jsonl")
     r.dump(path)
     with open(path) as f:
-        row = json.loads(f.readline())
+        rows = [json.loads(line) for line in f]
+    row = rows[-1]  # rows[0] is the incarnation header
     assert row["seq"] == 1 and "object" in row["payload"]

@@ -46,6 +46,7 @@ PATTERNS = (
     "OVERLAY_r*.json",
     "EPOCH_r*.json",
     "KNN_r*.json",
+    "OPS_r*.json",
 )
 
 _ROUND_RE = re.compile(r"_r(\d+)$")
@@ -82,6 +83,22 @@ def _sustained(doc: dict) -> float | None:
     return None
 
 
+def _slo_breaches(doc: dict) -> int | None:
+    """Breached-SLO count carried by an artifact's ``detail.slo`` (the
+    ``--slo`` lane verdict of serve_bench/stream_bench), or None when
+    the lane didn't run."""
+    if not isinstance(doc, dict):
+        return None
+    if "parsed" in doc or "tail" in doc:  # driver wrapper
+        doc = doc.get("parsed")
+        if not isinstance(doc, dict):
+            return None
+    slo = (doc.get("detail") or {}).get("slo")
+    if isinstance(slo, dict) and isinstance(slo.get("breached"), list):
+        return len(slo["breached"])
+    return None
+
+
 def _headline(doc: dict) -> dict | None:
     """The ``{metric, value, unit}`` of one artifact, or None."""
     if not isinstance(doc, dict):
@@ -103,6 +120,7 @@ def collect(root: str) -> dict:
     lanes: dict = {}
     skipped: list = []
     sustained: list = []
+    slo_pts: list = []
     seen = set()
     for pat in PATTERNS:
         for path in sorted(glob.glob(os.path.join(root, pat))):
@@ -132,6 +150,13 @@ def collect(root: str) -> dict:
                     "metric": "sustained_frac_of_single",
                     "value": sv, "unit": "frac",
                 })
+            nb = _slo_breaches(doc)
+            if nb is not None:
+                slo_pts.append({
+                    "round": rnd, "file": fname,
+                    "metric": "slo_breaches",
+                    "value": nb, "unit": "count",
+                })
             head = _headline(doc)
             if head is None:
                 if sv is None:
@@ -150,6 +175,10 @@ def collect(root: str) -> dict:
         # sustained-vs-single (STREAM bench lines, STALL reports) in
         # one trajectory — the gap-closing story in a single row
         lanes["sustained_frac_of_single"] = sustained
+    if slo_pts:
+        # cross-lane series: breached-SLO counts from every --slo lane
+        # artifact — the ops-plane headline (should stay 0)
+        lanes["slo_breaches"] = slo_pts
     out = {}
     for lane, pts in sorted(lanes.items()):
         pts.sort(

@@ -29,6 +29,14 @@ Every lane's child answers a fixed probe set and reports its SHA-256;
 the parent asserts ALL lanes hash identically. The last stdout line is
 one machine-parseable JSON object (committed as ``SERVE_RESTART_r16``).
 
+Fleet story: every child exports its telemetry trail (early flush
+before the load phase, so a SIGKILLed child still leaves evidence;
+final flush when it survives), each headed by the child's incarnation
+id. The parent stitches ALL of them with `tools/fleet_report.py` into
+one wall-clock timeline — ``detail.fleet`` carries the restart chain
+(one link per incarnation, with the dark-gap seconds between a kill
+and the relaunch's first event).
+
 CPU CI smoke:
   JAX_PLATFORMS=cpu MOSAIC_BENCH_PLATFORM=cpu python tools/restart_bench.py \
       --restarts 2 --requests 120 --rate 120
@@ -157,6 +165,7 @@ def child_main(args) -> None:
         bc1 = backend_compiles()
         report = {
             "phase": "serving",
+            "incarnation": telemetry.INCARNATION,
             "warmup": warm,
             "warmup_wall_s": round(warmup_wall, 3),
             "startup_wall_s": round(time.perf_counter() - t0, 3),
@@ -171,6 +180,13 @@ def child_main(args) -> None:
         # early flush BEFORE the load phase: a SIGKILLed child still
         # leaves its warmup/compile story for the parent to assert on
         _write_report(args.report, report)
+        if args.trail:
+            # same early-flush discipline for the trail: a SIGKILL
+            # mid-load must still leave this incarnation's warmup
+            # events for the parent's fleet stitch
+            from mosaic_tpu import obs
+
+            obs.write_jsonl(list(events), args.trail)
 
         rng = np.random.default_rng(args.seed)
         reqs = [
@@ -219,11 +235,15 @@ def child_main(args) -> None:
     )
     engine.close()
     _write_report(args.report, report)
+    if args.trail:
+        from mosaic_tpu import obs
+
+        obs.write_jsonl(events, args.trail)
 
 
 # --------------------------------------------------------------- parent
 
-def _spawn(store: str, report: str, args, extra=()):
+def _spawn(store: str, report: str, args, extra=(), trail=None):
     if os.path.exists(report):
         os.remove(report)
     cmd = [
@@ -232,6 +252,7 @@ def _spawn(store: str, report: str, args, extra=()):
         "--requests", str(args.requests), "--rate", str(args.rate),
         "--rows-max", str(args.rows_max), "--queue-cap", str(args.queue_cap),
         "--deadline-ms", str(args.deadline_ms), "--seed", str(args.seed),
+        *(("--trail", trail) if trail else ()),
         *extra,
     ]
     return subprocess.Popen(cmd, stdout=sys.stderr, stderr=sys.stderr)
@@ -255,8 +276,10 @@ def _wait_report(proc, report: str, timeout: float) -> dict:
     raise RuntimeError(f"no child report after {timeout}s")
 
 
-def _run_to_completion(store: str, report: str, args, timeout=600.0) -> dict:
-    proc = _spawn(store, report, args)
+def _run_to_completion(
+    store: str, report: str, args, timeout=600.0, trail=None
+) -> dict:
+    proc = _spawn(store, report, args, trail=trail)
     rc = proc.wait(timeout=timeout)
     if rc != 0:
         raise RuntimeError(f"child failed rc={rc}")
@@ -267,10 +290,12 @@ def _run_to_completion(store: str, report: str, args, timeout=600.0) -> dict:
     return out
 
 
-def _kill_mid_load(store: str, report: str, args, kill_after: float) -> dict:
+def _kill_mid_load(
+    store: str, report: str, args, kill_after: float, trail=None
+) -> dict:
     """Launch, wait for the early report (serving has begun), then
     SIGKILL mid-load and return the early report."""
-    proc = _spawn(store, report, args)
+    proc = _spawn(store, report, args, trail=trail)
     out = _wait_report(proc, report, timeout=600.0)
     time.sleep(kill_after)
     if proc.poll() is None:
@@ -280,10 +305,10 @@ def _kill_mid_load(store: str, report: str, args, kill_after: float) -> dict:
         return json.load(f)
 
 
-def _kill_mid_export(store: str, report: str, args) -> int:
+def _kill_mid_export(store: str, report: str, args, trail=None) -> int:
     """Launch against a fresh store and SIGKILL the instant the first
     payload file lands — the tightest window around the export write."""
-    proc = _spawn(store, report, args)
+    proc = _spawn(store, report, args, trail=trail)
     t0 = time.monotonic()
     while time.monotonic() - t0 < 600.0:
         if glob.glob(os.path.join(store, "prog-*.bin")):
@@ -312,6 +337,10 @@ def main() -> None:
     ap.add_argument("--kill-after", type=float, default=0.4,
                     help="seconds into the load phase to SIGKILL")
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--trail", default=None,
+                    help="(child) export this lifetime's telemetry "
+                    "trail as JSONL, incarnation-headed; the parent "
+                    "sets this per child and stitches the fleet")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -341,9 +370,17 @@ def main() -> None:
         work = tempfile.mkdtemp(prefix="restart_bench_")
         store = os.path.join(work, "programs")
         report = os.path.join(work, "report.json")
+        fleet_dir = os.path.join(work, "fleet")
+        os.makedirs(fleet_dir, exist_ok=True)
+        fleet_trails: list[str] = []
+
+        def _t(lane: str) -> str:
+            path = os.path.join(fleet_dir, f"{lane}.jsonl")
+            fleet_trails.append(path)
+            return path
 
         # ---- cold: empty store, full run; exports the ladder
-        cold = _run_to_completion(store, report, args)
+        cold = _run_to_completion(store, report, args, trail=_t("cold"))
         detail["cold"] = {
             k: cold[k] for k in (
                 "warmup_wall_s", "startup_wall_s", "backend_compiles",
@@ -362,9 +399,14 @@ def main() -> None:
         for i in range(max(args.restarts, 1)):
             final = i == args.restarts - 1
             if final:
-                rep = _run_to_completion(store, report, args)
+                rep = _run_to_completion(
+                    store, report, args, trail=_t(f"storm_{i}")
+                )
             else:
-                rep = _kill_mid_load(store, report, args, args.kill_after)
+                rep = _kill_mid_load(
+                    store, report, args, args.kill_after,
+                    trail=_t(f"storm_{i}"),
+                )
             aot = rep["warmup"].get("aot") or {}
             entry = {
                 "killed": not final,
@@ -405,9 +447,13 @@ def main() -> None:
         # ---- kill mid-export: fresh store, SIGKILL inside the export
         # window; the relaunch sees at worst an orphaned payload
         store2 = os.path.join(work, "programs_killed")
-        payloads_at_kill = _kill_mid_export(store2, report, args)
+        payloads_at_kill = _kill_mid_export(
+            store2, report, args, trail=_t("kill_mid_export")
+        )
         sidecars_at_kill = len(glob.glob(os.path.join(store2, "prog-*.json")))
-        rep = _run_to_completion(store2, report, args)
+        rep = _run_to_completion(
+            store2, report, args, trail=_t("relaunch")
+        )
         detail["kill_mid_export"] = {
             "payloads_at_kill": payloads_at_kill,
             "sidecars_at_kill": sidecars_at_kill,
@@ -425,7 +471,7 @@ def main() -> None:
         blob[len(blob) // 2] ^= 0xFF
         with open(victim, "wb") as f:
             f.write(blob)
-        rep = _run_to_completion(store, report, args)
+        rep = _run_to_completion(store, report, args, trail=_t("corrupt"))
         detail["corrupt"] = {
             "aot": rep["warmup"].get("aot"),
             "cold_compiles": rep["cold_compiles"],
@@ -442,13 +488,34 @@ def main() -> None:
         )
         check(rep["cold_compiles"] == 0, "corrupt lane still serves")
         # self-heal proof: one more run loads everything cleanly
-        rep = _run_to_completion(store, report, args)
+        rep = _run_to_completion(store, report, args, trail=_t("healed"))
         hashes["healed"] = rep["answers_sha256"]
         check(
             rep["store_events"]["corrupt_skipped"] == 0
             and rep["warmup"]["aot"]["exported"] == 0
             and rep["backend_compiles"] in (0, None),
             "store fully healed after corrupt-lane re-export",
+        )
+
+        # ---- fleet stitch: every child trail (killed children left
+        # their early flush) merged onto one wall-clock timeline
+        import fleet_report as _fleet
+
+        live = [p for p in fleet_trails if os.path.exists(p)]
+        _, fleet = _fleet.stitch(live)
+        detail["fleet"] = {
+            "trails": len(live),
+            "incarnations": len(fleet["incarnations"]),
+            "chain": fleet["chain"],
+        }
+        check(
+            len(fleet["incarnations"]) == len(live),
+            f"fleet stitch: one incarnation per child "
+            f"({len(fleet['incarnations'])} vs {len(live)} trails)",
+        )
+        check(
+            all("gap_s" in link for link in fleet["chain"][1:]),
+            "fleet chain links every incarnation to its predecessor",
         )
 
         detail["answers_sha256"] = hashes

@@ -249,6 +249,11 @@ def main() -> None:
     ap.add_argument("--poison", type=int, default=0,
                     help="inject N NaN rows into one request "
                     "(quarantine demo lane)")
+    ap.add_argument("--slo", action="store_true",
+                    help="evaluate the run's trail against the default "
+                    "SLO specs (MOSAIC_SLO_* thresholds) over the whole "
+                    "run; verdicts land in detail.slo and breaches emit "
+                    "real slo_violation events into the trail")
     ap.add_argument("--trail", default=None,
                     help="export the captured telemetry trail "
                     "(spans included) as JSONL")
@@ -304,6 +309,12 @@ def main() -> None:
             line["metric"], line["unit"] = "victim_shed_rate", "fraction"
             with telemetry.capture() as events:
                 line["value"] = _tenant_ab(index, h3, bbox, args, detail)
+                if args.slo:
+                    # inside capture: breach transitions emit REAL
+                    # slo_violation events that land in the trail
+                    from mosaic_tpu.obs import slo as _slo
+
+                    detail["slo"] = _slo.evaluate_trail(events)
             if args.trail or args.chrome_trace:
                 from mosaic_tpu import obs
 
@@ -405,6 +416,12 @@ def main() -> None:
                     except Overloaded:
                         pass
             load_wall = time.perf_counter() - t_load
+            if args.slo:
+                # inside capture: breach transitions emit REAL
+                # slo_violation events that land in the exported trail
+                from mosaic_tpu.obs import slo as _slo
+
+                detail["slo"] = _slo.evaluate_trail(events)
 
         m = engine.metrics()
         lat = telemetry.summarize(events, event="serve_request")

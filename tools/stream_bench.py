@@ -92,6 +92,12 @@ def main() -> None:
     ap.add_argument("--poison", type=int, default=0,
                     help="inject N NaN rows into the staged batches "
                     "before admission (quarantine demo lane)")
+    ap.add_argument("--slo", action="store_true",
+                    help="evaluate the run's trail against the default "
+                    "SLO specs (MOSAIC_SLO_* thresholds; set "
+                    "MOSAIC_SLO_STREAM_RATE_MIN for the sustained-rate "
+                    "floor) — verdicts land in detail.slo and breaches "
+                    "emit real slo_violation events into the trail")
     ap.add_argument("--trail", default=None,
                     help="export the captured telemetry trail "
                     "(spans included) as JSONL")
@@ -498,6 +504,12 @@ def main() -> None:
                 "census fallback should at least see the staged batch"
             )
         root_span.end()
+        if args.slo:
+            # still inside the capture scope: breach transitions emit
+            # REAL slo_violation events that land in the exported trail
+            from mosaic_tpu.obs import slo as _slo
+
+            detail["slo"] = _slo.evaluate_trail(stages)
         cap_events.__exit__(None, None, None)
     except Exception as e:  # the artifact line must still parse
         detail["error"] = repr(e)[:400]

@@ -19,10 +19,16 @@ object with ``detail.stages``/``detail.trail``. The human-readable
 report goes to stderr; the LAST stdout line is always one
 machine-parseable JSON object (the repo-wide bench contract).
 
+``--fleet`` accepts MANY trails (different processes' exports, flight-
+recorder dumps) and stitches them onto one wall-clock axis via their
+incarnation headers (`tools/fleet_report.py` does the merging) before
+reporting — the breakdown then covers the whole storm, not one child.
+
 Usage:
   python tools/serve_bench.py ... --trail /tmp/serve.jsonl
   python tools/trace_report.py /tmp/serve.jsonl
   python tools/trace_report.py fresh.jsonl --against golden.jsonl
+  python tools/trace_report.py --fleet /tmp/storm/*.jsonl
 """
 
 from __future__ import annotations
@@ -114,21 +120,36 @@ def diff_breakdown(fresh: dict, base: dict) -> dict:
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("trail", help="JSONL trail or bench artifact")
+    ap.add_argument("trail", nargs="+",
+                    help="JSONL trail or bench artifact (several with "
+                         "--fleet)")
     ap.add_argument("--against", default=None,
                     help="second trail to diff against")
+    ap.add_argument("--fleet", action="store_true",
+                    help="stitch MANY trails by incarnation header "
+                         "(fleet_report) and report over the merge")
     ap.add_argument("--out", default=None,
                     help="also write the JSON report here")
     args = ap.parse_args()
 
     from mosaic_tpu.obs import export, trace_summary
 
-    events = export.read_trail(args.trail)
+    if args.fleet:
+        import fleet_report as _fleet
+
+        events, fleet = _fleet.stitch(args.trail)
+        trail_name = ",".join(args.trail)
+    elif len(args.trail) > 1:
+        ap.error("multiple trails require --fleet")
+    else:
+        events = export.read_trail(args.trail[0])
+        fleet = None
+        trail_name = args.trail[0]
     stages = stage_breakdown(events)
     traces = trace_summary(events)
     report = {
         "metric": "trace_report",
-        "trail": args.trail,
+        "trail": trail_name,
         "events": len(events),
         "spans": sum(t["spans"] for t in traces.values()),
         "traces": len(traces),
@@ -138,11 +159,25 @@ def main() -> None:
         ),
         "stages": stages,
     }
+    if fleet is not None:
+        report["fleet"] = {
+            "incarnations": len(fleet["incarnations"]),
+            "chain": fleet["chain"],
+            "cross_incarnation_traces": fleet["cross_incarnation_traces"],
+        }
 
     w = sys.stderr.write
-    w(f"trail: {args.trail} ({len(events)} events, "
+    w(f"trail: {trail_name} ({len(events)} events, "
       f"{report['spans']} spans in {report['traces']} traces, "
       f"{report['connected_traces']} fully connected)\n")
+    if fleet is not None:
+        for link in fleet["chain"]:
+            gap = (
+                f"  (+{link['gap_s']:.3f}s after {link['prev']})"
+                if "prev" in link else ""
+            )
+            w(f"  {link['incarnation']}: {link['events']} events over "
+              f"{link['span_s']:.3f}s{gap}\n")
     w(f"{'stage':<38} {'count':>6} {'total_s':>9} {'share':>6} "
       f"{'p50':>9} {'p99':>9}\n")
     for key, s in sorted(
